@@ -1,0 +1,21 @@
+"""predictionio_tpu — a TPU-native machine-learning server.
+
+A ground-up rebuild of the capabilities of Apache PredictionIO (incubating)
+— event collection, DASE engines, train/eval workflows, and low-latency
+query serving — with JAX/XLA on TPU as the compute backend instead of
+Spark executors, and a single-controller Python runtime instead of
+driver + executor JVMs.
+
+Layer map (mirrors reference SURVEY.md §1):
+  data/        event model, storage abstraction, stores  (ref: data/)
+  api/         Event Server REST daemon                  (ref: data/.../api/)
+  controller/  DASE user-facing SDK                      (ref: core/.../controller/)
+  workflow/    train/eval/deploy runtime                 (ref: core/.../workflow/)
+  tools/       CLI + admin + dashboard                   (ref: tools/)
+  e2/          reusable algorithm library                (ref: e2/)
+  ops/         TPU kernels (ALS, NB, top-k) — XLA/Pallas (ref: Spark MLlib calls)
+  parallel/    mesh + sharding utilities                 (ref: Spark shuffle/broadcast)
+  models/      engine templates                          (ref: examples/scala-parallel-*)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Event model, storage abstraction, and event stores.
+
+Reference: data/src/main/scala/org/apache/predictionio/data/.
+"""
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event, EventValidation
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = ["DataMap", "PropertyMap", "Event", "EventValidation", "BiMap"]

@@ -1,0 +1,78 @@
+"""`$set/$unset/$delete` property aggregation.
+
+Behavioral parity with the reference's LEventAggregator
+(data/src/main/scala/org/apache/predictionio/data/storage/LEventAggregator.scala:32-148)
+and the RDD variant PEventAggregator.scala:30-212. Semantics:
+
+- events are folded in eventTime order;
+- `$set` merges properties (right-biased) into the current map, creating it
+  if absent;
+- `$unset` removes the listed keys; on an absent map it stays absent
+  (it does NOT resurrect an empty map);
+- `$delete` drops the map entirely;
+- other event names are ignored;
+- first/lastUpdated track the event times of all special events seen,
+  including `$delete`s, so a later `$set` after a `$delete` keeps the
+  original firstUpdated.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterable, Optional, Tuple
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event
+
+#: Event names that control aggregation (LEventAggregator.scala:91)
+EVENT_NAMES = ["$set", "$unset", "$delete"]
+
+_Prop = Tuple[Optional[DataMap], Optional[_dt.datetime], Optional[_dt.datetime]]
+
+
+def _fold(prop: _Prop, e: Event) -> _Prop:
+    dm, first, last = prop
+    if e.event == "$set":
+        dm = e.properties if dm is None else dm.union(e.properties)
+    elif e.event == "$unset":
+        dm = None if dm is None else dm.remove(e.properties.key_set())
+    elif e.event == "$delete":
+        dm = None
+    else:
+        return prop
+    t = e.event_time
+    first = t if first is None else min(first, t)
+    last = t if last is None else max(last, t)
+    return (dm, first, last)
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
+    """Fold one entity's events into its current PropertyMap, or None.
+
+    Mirror of LEventAggregator.aggregatePropertiesSingle
+    (LEventAggregator.scala:70-88).
+    """
+    prop: _Prop = (None, None, None)
+    for e in sorted(events, key=lambda ev: ev.event_time):
+        prop = _fold(prop, e)
+    dm, first, last = prop
+    if dm is None:
+        return None
+    assert first is not None and last is not None
+    return PropertyMap(dm.fields, first_updated=first, last_updated=last)
+
+
+def aggregate_properties(events: Iterable[Event]) -> Dict[str, PropertyMap]:
+    """Group by entityId then fold; entities whose map ends absent are dropped.
+
+    Mirror of LEventAggregator.aggregateProperties (LEventAggregator.scala:42-60).
+    """
+    by_entity: Dict[str, list] = {}
+    for e in events:
+        by_entity.setdefault(e.entity_id, []).append(e)
+    out: Dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        pm = aggregate_properties_single(evs)
+        if pm is not None:
+            out[entity_id] = pm
+    return out

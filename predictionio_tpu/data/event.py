@@ -1,0 +1,217 @@
+"""The Event value type and validation rules.
+
+Behavioral parity with the reference's Event/EventValidation
+(data/src/main/scala/org/apache/predictionio/data/storage/Event.scala:42-167):
+reserved `$`-prefixed and `pio_`-prefixed names, the special events
+`$set/$unset/$delete`, target-entity pairing rules, and the `pio_pr`
+built-in entity type.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.data.datamap import DataMap
+
+UTC = _dt.timezone.utc
+
+
+def utcnow() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+def _truncate_ms(t: _dt.datetime) -> _dt.datetime:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=UTC)
+    return t.replace(microsecond=(t.microsecond // 1000) * 1000)
+
+
+def parse_event_time(value: Optional[str]) -> _dt.datetime:
+    """Parse an ISO-8601 timestamp; naive times are taken as UTC."""
+    if value is None:
+        return utcnow()
+    s = value.strip()
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    t = _dt.datetime.fromisoformat(s)
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=UTC)
+    return t
+
+
+def format_event_time(t: _dt.datetime) -> str:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=UTC)
+    return t.astimezone(UTC).isoformat(timespec="milliseconds").replace("+00:00", "Z")
+
+
+@dataclass(frozen=True)
+class Event:
+    """A single event in the Event Store (Event.scala:42-53)."""
+
+    event: str
+    entity_type: str
+    entity_id: str
+    event_id: Optional[str] = None
+    target_entity_type: Optional[str] = None
+    target_entity_id: Optional[str] = None
+    properties: DataMap = field(default_factory=DataMap)
+    event_time: _dt.datetime = field(default_factory=utcnow)
+    tags: Tuple[str, ...] = ()
+    pr_id: Optional[str] = None
+    creation_time: _dt.datetime = field(default_factory=utcnow)
+
+    def __post_init__(self):
+        # Times are millisecond precision (joda DateTime parity); list tags
+        # are coerced to tuples so Events stay hashable.
+        object.__setattr__(self, "event_time", _truncate_ms(self.event_time))
+        object.__setattr__(self, "creation_time", _truncate_ms(self.creation_time))
+        if not isinstance(self.tags, tuple):
+            object.__setattr__(self, "tags", tuple(self.tags))
+
+    def with_event_id(self, event_id: str) -> "Event":
+        return replace(self, event_id=event_id)
+
+    # -- JSON wire format (EventJson4sSupport.scala field names) ------------
+    def to_dict(self, with_event_id: bool = True) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if with_event_id and self.event_id is not None:
+            d["eventId"] = self.event_id
+        d["event"] = self.event
+        d["entityType"] = self.entity_type
+        d["entityId"] = self.entity_id
+        if self.target_entity_type is not None:
+            d["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            d["targetEntityId"] = self.target_entity_id
+        d["properties"] = self.properties.to_dict()
+        d["eventTime"] = format_event_time(self.event_time)
+        if self.tags:
+            d["tags"] = list(self.tags)
+        if self.pr_id is not None:
+            d["prId"] = self.pr_id
+        d["creationTime"] = format_event_time(self.creation_time)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], validate: bool = True) -> "Event":
+        """Parse the API wire format; raises ValueError on malformed input.
+
+        Storage backends reconstructing already-persisted rows pass
+        validate=False so one bad historical row cannot poison reads.
+        """
+        if not isinstance(d, dict):
+            raise ValueError("event JSON must be an object")
+        try:
+            name = d["event"]
+            entity_type = d["entityType"]
+            entity_id = d["entityId"]
+        except KeyError as e:
+            raise ValueError(f"field {e.args[0]} is required") from None
+        for f in ("event", "entityType", "entityId"):
+            if not isinstance(d[f], str):
+                raise ValueError(f"field {f} must be a string")
+        props = d.get("properties") or {}
+        if not isinstance(props, dict):
+            raise ValueError("field properties must be an object")
+        tags = d.get("tags") or []
+        if not isinstance(tags, list):
+            raise ValueError("field tags must be an array")
+        ev = cls(
+            event=name,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_id=d.get("eventId"),
+            target_entity_type=d.get("targetEntityType"),
+            target_entity_id=d.get("targetEntityId"),
+            properties=DataMap(props),
+            event_time=parse_event_time(d.get("eventTime")),
+            tags=[str(t) for t in tags],
+            pr_id=d.get("prId"),
+            creation_time=parse_event_time(d.get("creationTime")),
+        )
+        if validate:
+            EventValidation.validate(ev)
+        return ev
+
+    @classmethod
+    def from_json(cls, s: str, validate: bool = True) -> "Event":
+        return cls.from_dict(json.loads(s), validate=validate)
+
+
+class EventValidation:
+    """Validation rules for events (Event.scala:68-167)."""
+
+    default_time_zone = UTC
+    special_events = {"$set", "$unset", "$delete"}
+    builtin_entity_types = {"pio_pr"}
+    builtin_properties: set = set()
+
+    @staticmethod
+    def is_reserved_prefix(name: str) -> bool:
+        return name.startswith("$") or name.startswith("pio_")
+
+    @classmethod
+    def is_special_event(cls, name: str) -> bool:
+        return name in cls.special_events
+
+    @classmethod
+    def is_builtin_entity_type(cls, name: str) -> bool:
+        return name in cls.builtin_entity_types
+
+    @classmethod
+    def validate(cls, e: Event) -> None:
+        def req(cond: bool, msg: str) -> None:
+            if not cond:
+                raise ValueError(msg)
+
+        req(bool(e.event), "event must not be empty.")
+        req(bool(e.entity_type), "entityType must not be empty string.")
+        req(bool(e.entity_id), "entityId must not be empty string.")
+        req(e.target_entity_type != "", "targetEntityType must not be empty string")
+        req(e.target_entity_id != "", "targetEntityId must not be empty string.")
+        req(
+            not (e.target_entity_type is not None and e.target_entity_id is None),
+            "targetEntityType and targetEntityId must be specified together.",
+        )
+        req(
+            not (e.target_entity_type is None and e.target_entity_id is not None),
+            "targetEntityType and targetEntityId must be specified together.",
+        )
+        req(
+            not (e.event == "$unset" and e.properties.is_empty),
+            "properties cannot be empty for $unset event",
+        )
+        req(
+            not cls.is_reserved_prefix(e.event) or cls.is_special_event(e.event),
+            f"{e.event} is not a supported reserved event name.",
+        )
+        req(
+            not cls.is_special_event(e.event)
+            or (e.target_entity_type is None and e.target_entity_id is None),
+            f"Reserved event {e.event} cannot have targetEntity",
+        )
+        req(
+            not cls.is_reserved_prefix(e.entity_type)
+            or cls.is_builtin_entity_type(e.entity_type),
+            f"The entityType {e.entity_type} is not allowed. "
+            "'pio_' is a reserved name prefix.",
+        )
+        req(
+            e.target_entity_type is None
+            or not cls.is_reserved_prefix(e.target_entity_type)
+            or cls.is_builtin_entity_type(e.target_entity_type),
+            f"The targetEntityType {e.target_entity_type} is not allowed. "
+            "'pio_' is a reserved name prefix.",
+        )
+        for k in e.properties.key_set():
+            req(
+                not cls.is_reserved_prefix(k) or k in cls.builtin_properties,
+                f"The property {k} is not allowed. 'pio_' is a reserved name prefix.",
+            )

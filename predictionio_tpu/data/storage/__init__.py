@@ -1,0 +1,237 @@
+"""Storage registry — env-var-driven backend selection.
+
+Parity with the reference's `Storage` object
+(data/src/main/scala/org/apache/predictionio/data/storage/Storage.scala:120-435):
+
+- sources come from ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ arbitrary extra
+  keys, e.g. ``..._PATH``), mirroring Storage.scala:132-148;
+- repositories bind {METADATA, EVENTDATA, MODELDATA} to a source via
+  ``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}`` (Storage.scala:150-173);
+- data objects are discovered by naming convention inside the backend module
+  ``predictionio_tpu.data.storage.<type>`` — class ``<Prefix><Entity>``
+  (Storage.scala:279-328), with the module registry replacing JVM
+  ``Class.forName`` reflection;
+- when no env config is present, everything defaults to a single SQLite file
+  under ``$PIO_FS_BASEDIR`` (default ``~/.pio_store``) so a fresh install
+  works with zero configuration (improvement over the reference, which
+  requires pio-env.sh).
+
+Test processes can call :func:`use_memory_storage` to run fully in-memory.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (  # re-export for convenience
+    AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
+    EngineInstances, EvaluationInstance, EvaluationInstances, Events, Model,
+    Models, NONE_FILTER,
+)
+
+__all__ = [
+    "AccessKey", "AccessKeys", "App", "Apps", "Channel", "Channels",
+    "EngineInstance", "EngineInstances", "EvaluationInstance",
+    "EvaluationInstances", "Events", "Model", "Models", "NONE_FILTER",
+    "StorageClientConfig", "Storage", "get_storage", "use_memory_storage",
+    "reset_storage",
+]
+
+MetaData = "METADATA"
+EventData = "EVENTDATA"
+ModelData = "MODELDATA"
+
+#: Entity-name → class-name prefix convention per repository
+#: (Storage.scala:279-328 uses e.g. "HB"+"LEvents"; here the prefix is the
+#: capitalized backend type, e.g. Sqlite+Events, Memory+Apps, LocalFS+Models).
+_ENTITY_CLASSES = {
+    "Events": "Events",
+    "Apps": "Apps",
+    "AccessKeys": "AccessKeys",
+    "Channels": "Channels",
+    "EngineInstances": "EngineInstances",
+    "EvaluationInstances": "EvaluationInstances",
+    "Models": "Models",
+}
+
+_CLASS_PREFIX = {"sqlite": "Sqlite", "memory": "Memory", "localfs": "LocalFS"}
+
+
+@dataclass
+class StorageClientConfig:
+    """Mirror of StorageClientConfig (Storage.scala:95-101)."""
+    parallel: bool = False
+    test: bool = False
+    properties: Dict[str, str] = field(default_factory=dict)
+
+
+class Storage:
+    """A configured set of repositories. Normally used via the module-level
+    singleton (:func:`get_storage`), but instantiable for tests."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        self._env = dict(env if env is not None else os.environ)
+        self._clients: Dict[str, Any] = {}
+        self._objects: Dict[tuple, Any] = {}
+        self._lock = threading.RLock()
+        self._sources = self._parse_sources()
+        self._repos = self._parse_repositories()
+
+    # -- env parsing (Storage.scala:132-173) --------------------------------
+    def _parse_sources(self) -> Dict[str, Dict[str, str]]:
+        sources: Dict[str, Dict[str, str]] = {}
+        prefix = "PIO_STORAGE_SOURCES_"
+        for k, v in self._env.items():
+            if k.startswith(prefix) and k.endswith("_TYPE"):
+                name = k[len(prefix):-len("_TYPE")]
+                props = {"TYPE": v}
+                keyprefix = f"{prefix}{name}_"
+                for k2, v2 in self._env.items():
+                    if k2.startswith(keyprefix) and k2 != k:
+                        props[k2[len(keyprefix):]] = v2
+                sources[name] = props
+        if not sources:
+            basedir = os.path.expanduser(
+                self._env.get("PIO_FS_BASEDIR", "~/.pio_store"))
+            sources["DEFAULT"] = {
+                "TYPE": "sqlite",
+                "PATH": os.path.join(basedir, "pio.sqlite"),
+                "BASEDIR": basedir,
+            }
+            sources["LOCALFS"] = {
+                "TYPE": "localfs",
+                "PATH": os.path.join(basedir, "models"),
+            }
+        return sources
+
+    def _parse_repositories(self) -> Dict[str, str]:
+        repos: Dict[str, str] = {}
+        for repo in (MetaData, EventData, ModelData):
+            src = self._env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
+            if src:
+                repos[repo] = src
+            elif "DEFAULT" in self._sources:
+                repos[repo] = (
+                    "LOCALFS" if repo == ModelData and "LOCALFS" in self._sources
+                    else "DEFAULT")
+            else:
+                raise RuntimeError(
+                    f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE is not set and "
+                    "no default source is available")
+        return repos
+
+    # -- client + DAO construction (Storage.scala:218-328) ------------------
+    def _client_for(self, source_name: str):
+        with self._lock:
+            if source_name in self._clients:
+                return self._clients[source_name]
+            props = self._sources.get(source_name)
+            if props is None:
+                raise RuntimeError(f"Undefined storage source: {source_name}")
+            backend_type = props["TYPE"]
+            module = importlib.import_module(
+                f"predictionio_tpu.data.storage.{backend_type}")
+            config = StorageClientConfig(properties=dict(props))
+            client = module.StorageClient(config)
+            self._clients[source_name] = (client, config, backend_type, module)
+            return self._clients[source_name]
+
+    def _get_data_object(self, repo: str, entity: str):
+        key = (repo, entity)
+        with self._lock:
+            if key in self._objects:
+                return self._objects[key]
+            source_name = self._repos[repo]
+            client, config, backend_type, module = self._client_for(source_name)
+            prefix = _CLASS_PREFIX.get(backend_type, backend_type.capitalize())
+            cls_name = prefix + _ENTITY_CLASSES[entity]
+            cls = getattr(module, cls_name, None)
+            if cls is None:
+                raise RuntimeError(
+                    f"Storage backend {backend_type!r} does not provide "
+                    f"{cls_name} (required for repository {repo})")
+            obj = cls(client, config, namespace="pio_" + repo.lower())
+            self._objects[key] = obj
+            return obj
+
+    # -- public accessors (Storage.scala:365-435) ---------------------------
+    def get_meta_data_apps(self) -> Apps:
+        return self._get_data_object(MetaData, "Apps")
+
+    def get_meta_data_access_keys(self) -> AccessKeys:
+        return self._get_data_object(MetaData, "AccessKeys")
+
+    def get_meta_data_channels(self) -> Channels:
+        return self._get_data_object(MetaData, "Channels")
+
+    def get_meta_data_engine_instances(self) -> EngineInstances:
+        return self._get_data_object(MetaData, "EngineInstances")
+
+    def get_meta_data_evaluation_instances(self) -> EvaluationInstances:
+        return self._get_data_object(MetaData, "EvaluationInstances")
+
+    def get_events(self) -> Events:
+        """The event store (reference getLEvents/getPEvents unified)."""
+        return self._get_data_object(EventData, "Events")
+
+    def get_model_data_models(self) -> Models:
+        return self._get_data_object(ModelData, "Models")
+
+    # -- verification (`pio status`; Storage.scala:341-363) -----------------
+    def verify_all_data_objects(self) -> None:
+        self.get_meta_data_apps()
+        self.get_meta_data_access_keys()
+        self.get_meta_data_channels()
+        self.get_meta_data_engine_instances()
+        self.get_meta_data_evaluation_instances()
+        self.get_model_data_models()
+        events = self.get_events()
+        events.init(0)
+        from predictionio_tpu.data.event import Event
+        test_id = events.insert(
+            Event(event="test", entity_type="test", entity_id=uuid.uuid4().hex),
+            app_id=0)
+        if not events.delete(test_id, app_id=0):
+            raise RuntimeError("event store write/delete verification failed")
+        events.remove(0)
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton
+# ---------------------------------------------------------------------------
+
+_storage: Optional[Storage] = None
+_storage_lock = threading.Lock()
+
+
+def get_storage() -> Storage:
+    global _storage
+    with _storage_lock:
+        if _storage is None:
+            _storage = Storage()
+        return _storage
+
+
+def use_memory_storage() -> Storage:
+    """Swap the singleton for a fresh all-in-memory Storage (tests)."""
+    global _storage
+    with _storage_lock:
+        _storage = Storage(env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        return _storage
+
+
+def reset_storage() -> None:
+    global _storage
+    with _storage_lock:
+        _storage = None

@@ -1,0 +1,485 @@
+"""SQLite storage backend — the file-backed default (dev parity with the
+reference's JDBC backend, storage/jdbc/.../JDBC*.scala).
+
+One database file holds events + the metadata ledger + model blobs. Events
+are rows with indexed filter columns plus the full JSON document; reads
+reconstruct Event values (including nested properties) at millisecond time
+precision — the canonical Event precision (joda DateTime parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import os
+import sqlite3
+import threading
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
+    NONE_FILTER,
+)
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _to_epoch_ms(t: _dt.datetime) -> int:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int((t - _EPOCH).total_seconds() * 1000)
+
+
+def _dt_to_iso(t: _dt.datetime) -> str:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return t.astimezone(_dt.timezone.utc).isoformat()
+
+
+def _iso_to_dt(s: str) -> _dt.datetime:
+    return _dt.datetime.fromisoformat(s)
+
+
+class StorageClient:
+    """Opens (or creates) the SQLite database file.
+
+    Config keys: PATH (db file path; default <basedir>/pio.sqlite).
+    """
+
+    def __init__(self, config):
+        self.config = config
+        path = config.properties.get("PATH")
+        if not path:
+            path = os.path.join(config.properties.get("BASEDIR", "."), "pio.sqlite")
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self.client = sqlite3.connect(path, check_same_thread=False)
+        self.client.execute("PRAGMA journal_mode=WAL")
+        self.lock = threading.RLock()
+
+
+class _Sqlite:
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self._c = client.client
+        self._lock = client.lock
+        self._ns = namespace
+        self._create_tables()
+
+    def _create_tables(self):
+        raise NotImplementedError
+
+    def _exec(self, sql, params=()):
+        with self._lock:
+            cur = self._c.execute(sql, params)
+            self._c.commit()
+            return cur
+
+    def _query(self, sql, params=()):
+        with self._lock:
+            return self._c.execute(sql, params).fetchall()
+
+
+class SqliteEvents(_Sqlite, base.Events):
+    def _create_tables(self):
+        self._exec(
+            """CREATE TABLE IF NOT EXISTS events (
+                 id TEXT PRIMARY KEY,
+                 app_id INTEGER NOT NULL,
+                 channel_id INTEGER,
+                 event TEXT NOT NULL,
+                 entity_type TEXT NOT NULL,
+                 entity_id TEXT NOT NULL,
+                 target_entity_type TEXT,
+                 target_entity_id TEXT,
+                 event_time_ms INTEGER NOT NULL,
+                 doc TEXT NOT NULL)"""
+        )
+        self._exec(
+            "CREATE INDEX IF NOT EXISTS idx_events_lookup ON events "
+            "(app_id, channel_id, event_time_ms)"
+        )
+        self._exec(
+            "CREATE INDEX IF NOT EXISTS idx_events_entity ON events "
+            "(app_id, channel_id, entity_type, entity_id)"
+        )
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return True  # single-table schema created in ctor
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._exec(
+            "DELETE FROM events WHERE app_id=? AND channel_id IS ?",
+            (app_id, channel_id),
+        )
+        return True
+
+    def close(self) -> None:
+        pass  # client owns the connection
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        event_id = event.event_id or uuid.uuid4().hex
+        stored = event.with_event_id(event_id)
+        self._exec(
+            "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?)",
+            (
+                event_id, app_id, channel_id, stored.event,
+                stored.entity_type, stored.entity_id,
+                stored.target_entity_type, stored.target_entity_id,
+                _to_epoch_ms(stored.event_time), stored.to_json(),
+            ),
+        )
+        return event_id
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        rows, ids = [], []
+        for event in events:
+            event_id = event.event_id or uuid.uuid4().hex
+            stored = event.with_event_id(event_id)
+            ids.append(event_id)
+            rows.append((
+                event_id, app_id, channel_id, stored.event,
+                stored.entity_type, stored.entity_id,
+                stored.target_entity_type, stored.target_entity_id,
+                _to_epoch_ms(stored.event_time), stored.to_json(),
+            ))
+        with self._lock:
+            self._c.executemany(
+                "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?)", rows)
+            self._c.commit()
+        return ids
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        rows = self._query(
+            "SELECT doc FROM events WHERE id=? AND app_id=? AND channel_id IS ?",
+            (event_id, app_id, channel_id),
+        )
+        return Event.from_json(rows[0][0], validate=False) if rows else None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        cur = self._exec(
+            "DELETE FROM events WHERE id=? AND app_id=? AND channel_id IS ?",
+            (event_id, app_id, channel_id),
+        )
+        return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        sql = ["SELECT doc FROM events WHERE app_id=? AND channel_id IS ?"]
+        params: list = [app_id, channel_id]
+        if start_time is not None:
+            sql.append("AND event_time_ms >= ?")
+            params.append(_to_epoch_ms(start_time))
+        if until_time is not None:
+            sql.append("AND event_time_ms < ?")
+            params.append(_to_epoch_ms(until_time))
+        if entity_type is not None:
+            sql.append("AND entity_type = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            sql.append("AND entity_id = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            if not event_names:
+                return iter(())  # empty filter list matches no events
+            sql.append(
+                "AND event IN (%s)" % ",".join("?" * len(event_names)))
+            params.extend(event_names)
+        for col, filt in (("target_entity_type", target_entity_type),
+                          ("target_entity_id", target_entity_id)):
+            if filt == NONE_FILTER:
+                sql.append(f"AND {col} IS NULL")
+            elif filt is not None:
+                sql.append(f"AND {col} = ?")
+                params.append(filt)
+        sql.append("ORDER BY event_time_ms " + ("DESC" if reversed_ else "ASC"))
+        if limit is not None and limit >= 0:
+            sql.append("LIMIT ?")
+            params.append(limit)
+        rows = self._query(" ".join(sql), tuple(params))
+        return (Event.from_json(r[0], validate=False) for r in rows)
+
+
+class SqliteApps(_Sqlite, base.Apps):
+    def _create_tables(self):
+        self._exec(
+            "CREATE TABLE IF NOT EXISTS apps "
+            "(id INTEGER PRIMARY KEY, name TEXT UNIQUE, description TEXT)")
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._lock:
+            try:
+                if app.id == 0:
+                    cur = self._c.execute(
+                        "INSERT INTO apps (name, description) VALUES (?,?)",
+                        (app.name, app.description))
+                else:
+                    cur = self._c.execute(
+                        "INSERT INTO apps VALUES (?,?,?)",
+                        (app.id, app.name, app.description))
+                self._c.commit()
+                return cur.lastrowid if app.id == 0 else app.id
+            except sqlite3.IntegrityError:
+                return None
+
+    def get(self, app_id: int) -> Optional[App]:
+        rows = self._query("SELECT id,name,description FROM apps WHERE id=?",
+                           (app_id,))
+        return App(*rows[0]) if rows else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        rows = self._query("SELECT id,name,description FROM apps WHERE name=?",
+                           (name,))
+        return App(*rows[0]) if rows else None
+
+    def get_all(self) -> List[App]:
+        return [App(*r) for r in
+                self._query("SELECT id,name,description FROM apps")]
+
+    def update(self, app: App) -> None:
+        self._exec("UPDATE apps SET name=?, description=? WHERE id=?",
+                   (app.name, app.description, app.id))
+
+    def delete(self, app_id: int) -> None:
+        self._exec("DELETE FROM apps WHERE id=?", (app_id,))
+
+
+class SqliteAccessKeys(_Sqlite, base.AccessKeys):
+    def _create_tables(self):
+        self._exec(
+            "CREATE TABLE IF NOT EXISTS access_keys "
+            "(key TEXT PRIMARY KEY, appid INTEGER, events TEXT)")
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        key = k.key or self.generate_key()
+        try:
+            self._exec("INSERT INTO access_keys VALUES (?,?,?)",
+                       (key, k.appid, json.dumps(list(k.events))))
+            return key
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        rows = self._query("SELECT key,appid,events FROM access_keys WHERE key=?",
+                           (key,))
+        if not rows:
+            return None
+        return AccessKey(rows[0][0], rows[0][1], tuple(json.loads(rows[0][2])))
+
+    def get_all(self) -> List[AccessKey]:
+        return [AccessKey(r[0], r[1], tuple(json.loads(r[2])))
+                for r in self._query("SELECT key,appid,events FROM access_keys")]
+
+    def get_by_appid(self, appid: int) -> List[AccessKey]:
+        return [AccessKey(r[0], r[1], tuple(json.loads(r[2]))) for r in
+                self._query("SELECT key,appid,events FROM access_keys "
+                            "WHERE appid=?", (appid,))]
+
+    def update(self, k: AccessKey) -> None:
+        self._exec("UPDATE access_keys SET appid=?, events=? WHERE key=?",
+                   (k.appid, json.dumps(list(k.events)), k.key))
+
+    def delete(self, key: str) -> None:
+        self._exec("DELETE FROM access_keys WHERE key=?", (key,))
+
+
+class SqliteChannels(_Sqlite, base.Channels):
+    def _create_tables(self):
+        self._exec(
+            "CREATE TABLE IF NOT EXISTS channels "
+            "(id INTEGER PRIMARY KEY, name TEXT, appid INTEGER)")
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        with self._lock:
+          try:
+            if channel.id == 0:
+                cur = self._c.execute(
+                    "INSERT INTO channels (name, appid) VALUES (?,?)",
+                    (channel.name, channel.appid))
+            else:
+                cur = self._c.execute("INSERT INTO channels VALUES (?,?,?)",
+                                      (channel.id, channel.name, channel.appid))
+            self._c.commit()
+            return cur.lastrowid if channel.id == 0 else channel.id
+          except sqlite3.IntegrityError:
+            return None
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        rows = self._query("SELECT id,name,appid FROM channels WHERE id=?",
+                           (channel_id,))
+        return Channel(*rows[0]) if rows else None
+
+    def get_by_appid(self, appid: int) -> List[Channel]:
+        return [Channel(*r) for r in
+                self._query("SELECT id,name,appid FROM channels WHERE appid=?",
+                            (appid,))]
+
+    def delete(self, channel_id: int) -> None:
+        self._exec("DELETE FROM channels WHERE id=?", (channel_id,))
+
+
+def _ei_to_row(i: EngineInstance):
+    return (
+        i.id, i.status, _dt_to_iso(i.start_time), _dt_to_iso(i.end_time),
+        i.engine_id, i.engine_version, i.engine_variant, i.engine_factory,
+        i.batch, json.dumps(i.env), json.dumps(i.runtime_conf),
+        i.data_source_params, i.preparator_params, i.algorithms_params,
+        i.serving_params,
+    )
+
+
+def _row_to_ei(r) -> EngineInstance:
+    return EngineInstance(
+        id=r[0], status=r[1], start_time=_iso_to_dt(r[2]),
+        end_time=_iso_to_dt(r[3]), engine_id=r[4], engine_version=r[5],
+        engine_variant=r[6], engine_factory=r[7], batch=r[8],
+        env=json.loads(r[9]), runtime_conf=json.loads(r[10]),
+        data_source_params=r[11], preparator_params=r[12],
+        algorithms_params=r[13], serving_params=r[14],
+    )
+
+
+class SqliteEngineInstances(_Sqlite, base.EngineInstances):
+    def _create_tables(self):
+        self._exec(
+            """CREATE TABLE IF NOT EXISTS engine_instances (
+                 id TEXT PRIMARY KEY, status TEXT, start_time TEXT,
+                 end_time TEXT, engine_id TEXT, engine_version TEXT,
+                 engine_variant TEXT, engine_factory TEXT, batch TEXT,
+                 env TEXT, runtime_conf TEXT, data_source_params TEXT,
+                 preparator_params TEXT, algorithms_params TEXT,
+                 serving_params TEXT)""")
+
+    def insert(self, i: EngineInstance) -> str:
+        instance_id = i.id or uuid.uuid4().hex
+        i = dataclasses.replace(i, id=instance_id)
+        self._exec(
+            "INSERT OR REPLACE INTO engine_instances VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)", _ei_to_row(i))
+        return instance_id
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        rows = self._query("SELECT * FROM engine_instances WHERE id=?",
+                           (instance_id,))
+        return _row_to_ei(rows[0]) if rows else None
+
+    def get_all(self) -> List[EngineInstance]:
+        return [_row_to_ei(r) for r in self._query("SELECT * FROM engine_instances")]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        rows = self._query(
+            "SELECT * FROM engine_instances WHERE status='COMPLETED' AND "
+            "engine_id=? AND engine_version=? AND engine_variant=? "
+            "ORDER BY start_time DESC",
+            (engine_id, engine_version, engine_variant))
+        return [_row_to_ei(r) for r in rows]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        rows = self.get_completed(engine_id, engine_version, engine_variant)
+        return rows[0] if rows else None
+
+    def update(self, i: EngineInstance) -> None:
+        self._exec(
+            "INSERT OR REPLACE INTO engine_instances VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)", _ei_to_row(i))
+
+    def delete(self, instance_id: str) -> None:
+        self._exec("DELETE FROM engine_instances WHERE id=?", (instance_id,))
+
+
+def _evi_to_row(i: EvaluationInstance):
+    return (
+        i.id, i.status, _dt_to_iso(i.start_time), _dt_to_iso(i.end_time),
+        i.evaluation_class, i.engine_params_generator_class, i.batch,
+        json.dumps(i.env), json.dumps(i.runtime_conf),
+        i.evaluator_results, i.evaluator_results_html, i.evaluator_results_json,
+    )
+
+
+def _row_to_evi(r) -> EvaluationInstance:
+    return EvaluationInstance(
+        id=r[0], status=r[1], start_time=_iso_to_dt(r[2]),
+        end_time=_iso_to_dt(r[3]), evaluation_class=r[4],
+        engine_params_generator_class=r[5], batch=r[6], env=json.loads(r[7]),
+        runtime_conf=json.loads(r[8]), evaluator_results=r[9],
+        evaluator_results_html=r[10], evaluator_results_json=r[11],
+    )
+
+
+class SqliteEvaluationInstances(_Sqlite, base.EvaluationInstances):
+    def _create_tables(self):
+        self._exec(
+            """CREATE TABLE IF NOT EXISTS evaluation_instances (
+                 id TEXT PRIMARY KEY, status TEXT, start_time TEXT,
+                 end_time TEXT, evaluation_class TEXT,
+                 engine_params_generator_class TEXT, batch TEXT, env TEXT,
+                 runtime_conf TEXT, evaluator_results TEXT,
+                 evaluator_results_html TEXT, evaluator_results_json TEXT)""")
+
+    def insert(self, i: EvaluationInstance) -> str:
+        instance_id = i.id or uuid.uuid4().hex
+        i = dataclasses.replace(i, id=instance_id)
+        self._exec(
+            "INSERT OR REPLACE INTO evaluation_instances VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?,?)", _evi_to_row(i))
+        return instance_id
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        rows = self._query("SELECT * FROM evaluation_instances WHERE id=?",
+                           (instance_id,))
+        return _row_to_evi(rows[0]) if rows else None
+
+    def get_all(self) -> List[EvaluationInstance]:
+        return [_row_to_evi(r)
+                for r in self._query("SELECT * FROM evaluation_instances")]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        rows = self._query(
+            "SELECT * FROM evaluation_instances WHERE status='EVALCOMPLETED' "
+            "ORDER BY start_time DESC")
+        return [_row_to_evi(r) for r in rows]
+
+    def update(self, i: EvaluationInstance) -> None:
+        self._exec(
+            "INSERT OR REPLACE INTO evaluation_instances VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?,?)", _evi_to_row(i))
+
+    def delete(self, instance_id: str) -> None:
+        self._exec("DELETE FROM evaluation_instances WHERE id=?", (instance_id,))
+
+
+class SqliteModels(_Sqlite, base.Models):
+    def _create_tables(self):
+        self._exec("CREATE TABLE IF NOT EXISTS models "
+                   "(id TEXT PRIMARY KEY, models BLOB)")
+
+    def insert(self, m: Model) -> None:
+        self._exec("INSERT OR REPLACE INTO models VALUES (?,?)",
+                   (m.id, m.models))
+
+    def get(self, model_id: str) -> Optional[Model]:
+        rows = self._query("SELECT id, models FROM models WHERE id=?",
+                           (model_id,))
+        return Model(rows[0][0], bytes(rows[0][1])) if rows else None
+
+    def delete(self, model_id: str) -> None:
+        self._exec("DELETE FROM models WHERE id=?", (model_id,))

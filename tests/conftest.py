@@ -1,0 +1,26 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+Mirrors the reference's strategy of testing distributed semantics on
+`local[*]` Spark (SURVEY.md §4): identical semantics, one process. Meshes
+built in tests span 8 virtual CPU devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from predictionio_tpu.data.storage import reset_storage, use_memory_storage  # noqa: E402
+
+
+@pytest.fixture()
+def memory_storage():
+    """A fresh all-in-memory Storage singleton per test."""
+    storage = use_memory_storage()
+    yield storage
+    reset_storage()

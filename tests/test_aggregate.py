@@ -1,0 +1,85 @@
+"""$set/$unset/$delete folding parity with LEventAggregator.scala:94-135."""
+
+import datetime as dt
+
+from predictionio_tpu.data.aggregate import aggregate_properties, aggregate_properties_single
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+
+
+def mk(event, entity_id, props, minute):
+    return Event(
+        event=event, entity_type="user", entity_id=entity_id,
+        properties=DataMap(props),
+        event_time=dt.datetime(2021, 1, 1, 0, minute, tzinfo=dt.timezone.utc),
+    )
+
+
+def test_set_merge_right_biased():
+    pm = aggregate_properties_single([
+        mk("$set", "u1", {"a": 1, "b": 2}, 0),
+        mk("$set", "u1", {"b": 5, "c": 6}, 1),
+    ])
+    assert pm is not None
+    assert pm.to_dict() == {"a": 1, "b": 5, "c": 6}
+    assert pm.first_updated.minute == 0
+    assert pm.last_updated.minute == 1
+
+
+def test_events_sorted_by_event_time_not_arrival():
+    pm = aggregate_properties_single([
+        mk("$set", "u1", {"b": 5}, 1),
+        mk("$set", "u1", {"b": 2}, 0),  # earlier, must lose
+    ])
+    assert pm.to_dict() == {"b": 5}
+
+
+def test_unset_removes_keys():
+    pm = aggregate_properties_single([
+        mk("$set", "u1", {"a": 1, "b": 2}, 0),
+        mk("$unset", "u1", {"a": 0}, 1),
+    ])
+    assert pm.to_dict() == {"b": 2}
+
+
+def test_unset_before_set_stays_absent():
+    pm = aggregate_properties_single([mk("$unset", "u1", {"a": 0}, 0)])
+    assert pm is None
+
+
+def test_delete_drops_entity():
+    pm = aggregate_properties_single([
+        mk("$set", "u1", {"a": 1}, 0),
+        mk("$delete", "u1", {}, 1),
+    ])
+    assert pm is None
+
+
+def test_set_after_delete_keeps_first_updated():
+    pm = aggregate_properties_single([
+        mk("$set", "u1", {"a": 1}, 0),
+        mk("$delete", "u1", {}, 1),
+        mk("$set", "u1", {"z": 9}, 2),
+    ])
+    assert pm.to_dict() == {"z": 9}
+    assert pm.first_updated.minute == 0  # times survive the $delete
+    assert pm.last_updated.minute == 2
+
+
+def test_non_special_events_ignored():
+    pm = aggregate_properties_single([
+        mk("$set", "u1", {"a": 1}, 0),
+        mk("rate", "u1", {"rating": 5}, 1),
+    ])
+    assert pm.to_dict() == {"a": 1}
+    assert pm.last_updated.minute == 0  # rate didn't touch times
+
+
+def test_aggregate_multi_entity():
+    out = aggregate_properties([
+        mk("$set", "u1", {"a": 1}, 0),
+        mk("$set", "u2", {"a": 2}, 0),
+        mk("$delete", "u2", {}, 1),
+    ])
+    assert set(out.keys()) == {"u1"}
+    assert out["u1"].to_dict() == {"a": 1}

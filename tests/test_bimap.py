@@ -1,0 +1,44 @@
+"""BiMap parity with BiMap.scala:28-167 + the vectorized encode path."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+def test_string_int_contiguous_and_stable():
+    m = BiMap.string_int(["b", "a", "b", "c", "a"])
+    assert len(m) == 3
+    assert sorted([m("a"), m("b"), m("c")]) == [0, 1, 2]
+    assert m("b") == 0  # first-appearance order
+
+
+def test_inverse():
+    m = BiMap.string_int(["x", "y"])
+    inv = m.inverse()
+    assert inv(m("x")) == "x"
+    assert inv(m("y")) == "y"
+
+
+def test_non_injective_rejected():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_encode_decode_array():
+    m = BiMap.string_int(["u%d" % i for i in range(100)])
+    keys = ["u5", "u99", "u0"]
+    arr = m.encode_array(keys)
+    assert arr.dtype == np.int32
+    assert m.decode_array(arr) == keys
+
+
+def test_string_double():
+    m = BiMap.string_double(["a", "b"])
+    assert isinstance(m("a"), float)
+
+
+def test_take_and_contains():
+    m = BiMap.string_int(["a", "b", "c"])
+    assert "a" in m and "z" not in m
+    assert len(m.take(2)) == 2

@@ -1,0 +1,65 @@
+"""DataMap/PropertyMap semantics (parity: data/.../DataMapSpec in reference)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
+
+
+def test_get_required_and_optional():
+    d = DataMap({"a": 1, "b": "x", "c": None})
+    assert d.get("a") == 1
+    assert d.get_str("b") == "x"
+    assert d.get_opt("missing") is None
+    assert d.get_opt("missing", 7) == 7
+    # JSON null behaves like absent for get_opt, error for get
+    assert d.get_opt("c") is None
+    with pytest.raises(DataMapError):
+        d.get("c")
+    with pytest.raises(DataMapError):
+        d.get("missing")
+
+
+def test_typed_getters():
+    d = DataMap({"f": 1.5, "i": 3, "l": [1, 2], "s": ["a", "b"]})
+    assert d.get_float("f") == 1.5
+    assert d.get_int("i") == 3
+    assert d.get_list("l") == [1, 2]
+    assert d.get_string_list("s") == ["a", "b"]
+    with pytest.raises(DataMapError):
+        d.get_list("f")
+
+
+def test_union_is_right_biased():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 9, "z": 3})
+    assert a.union(b).to_dict() == {"x": 1, "y": 9, "z": 3}
+    # originals untouched (immutability)
+    assert a.to_dict() == {"x": 1, "y": 2}
+
+
+def test_remove_keys():
+    a = DataMap({"x": 1, "y": 2, "z": 3})
+    assert a.remove(["y", "nope"]).to_dict() == {"x": 1, "z": 3}
+
+
+def test_extract_into_dataclass():
+    from dataclasses import dataclass
+
+    @dataclass
+    class P:
+        attr0: float
+        attr1: float
+
+    p = DataMap({"attr0": 1.0, "attr1": 2.0}).extract(P)
+    assert p == P(1.0, 2.0)
+
+
+def test_property_map_not_equal_datamap():
+    t = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    pm = PropertyMap({"a": 1}, first_updated=t, last_updated=t)
+    dm = DataMap({"a": 1})
+    assert pm != dm
+    assert pm == PropertyMap({"a": 1}, first_updated=t, last_updated=t)
+    assert pm.get("a") == 1

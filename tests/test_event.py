@@ -1,0 +1,101 @@
+"""Event validation parity with EventValidation (Event.scala:112-141)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, EventValidation, format_event_time, parse_event_time
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+def test_valid_plain_event():
+    EventValidation.validate(ev())
+
+
+def test_empty_fields_rejected():
+    for kw in ({"event": ""}, {"entity_type": ""}, {"entity_id": ""}):
+        with pytest.raises(ValueError):
+            EventValidation.validate(ev(**kw))
+
+
+def test_target_entity_pairing():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(target_entity_type="item"))
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(target_entity_id="i1"))
+    EventValidation.validate(ev(target_entity_type="item", target_entity_id="i1"))
+
+
+def test_unset_requires_properties():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(event="$unset"))
+    EventValidation.validate(ev(event="$unset", properties=DataMap({"a": 1})))
+
+
+def test_reserved_event_names():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(event="$not_special"))
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(event="pio_custom"))
+    EventValidation.validate(ev(event="$set"))
+    EventValidation.validate(ev(event="$delete"))
+
+
+def test_special_event_cannot_have_target():
+    with pytest.raises(ValueError):
+        EventValidation.validate(
+            ev(event="$set", target_entity_type="item", target_entity_id="i1"))
+
+
+def test_reserved_entity_type():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(entity_type="pio_user"))
+    EventValidation.validate(ev(entity_type="pio_pr"))  # built-in
+
+
+def test_reserved_property_prefix():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(properties=DataMap({"pio_x": 1})))
+
+
+def test_json_round_trip():
+    e = ev(
+        target_entity_type="item", target_entity_id="i1",
+        properties=DataMap({"rating": 4.5}),
+        event_time=dt.datetime(2021, 6, 1, 12, 0, 0, tzinfo=dt.timezone.utc),
+        tags=["a"], pr_id="pr1",
+    ).with_event_id("abc")
+    e2 = Event.from_json(e.to_json())
+    assert e2.event == "rate" and e2.entity_id == "u1"
+    assert e2.target_entity_id == "i1"
+    assert e2.properties.get_float("rating") == 4.5
+    assert e2.event_time == e.event_time
+    assert e2.pr_id == "pr1" and list(e2.tags) == ["a"] and e2.event_id == "abc"
+    assert isinstance(hash(e2), int)  # Events are hashable (dedup via set)
+
+
+def test_from_dict_malformed():
+    with pytest.raises(ValueError):
+        Event.from_dict({"entityType": "user", "entityId": "u1"})  # no event
+    with pytest.raises(ValueError):
+        Event.from_dict({"event": 3, "entityType": "user", "entityId": "u1"})
+    with pytest.raises(ValueError):
+        Event.from_dict(
+            {"event": "e", "entityType": "user", "entityId": "u1",
+             "properties": [1, 2]})
+
+
+def test_time_parse_formats():
+    t = parse_event_time("2021-06-01T12:00:00.123Z")
+    assert t.tzinfo is not None and t.microsecond == 123000
+    t2 = parse_event_time("2021-06-01T12:00:00+02:00")
+    assert t2.utcoffset() == dt.timedelta(hours=2)
+    naive = parse_event_time("2021-06-01T12:00:00")
+    assert naive.tzinfo == dt.timezone.utc
+    assert format_event_time(t) == "2021-06-01T12:00:00.123Z"

@@ -1,0 +1,255 @@
+"""Storage-backend contract suite: one behavioral spec, N backends.
+
+This is the reference's storage test pattern (SURVEY.md §4 tier 2 —
+LEventsSpec/PEventsSpec repeated per driver, e.g.
+storage/jdbc/src/test/.../LEventsSpec.scala) applied to the memory and
+sqlite backends.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import (
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
+    NONE_FILTER, Storage,
+)
+
+APP = 1
+UTC = dt.timezone.utc
+
+
+def t(minute):
+    return dt.datetime(2021, 1, 1, 0, minute, tzinfo=UTC)
+
+
+def mk(event="rate", entity_id="u1", target=None, minute=0, props=None):
+    return Event(
+        event=event, entity_type="user", entity_id=entity_id,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=t(minute),
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def storage(request, tmp_path):
+    if request.param == "memory":
+        env = {
+            "PIO_STORAGE_SOURCES_T_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "T",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T",
+        }
+    else:
+        env = {
+            "PIO_STORAGE_SOURCES_T_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_T_PATH": str(tmp_path / "t.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "T",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T",
+        }
+    return Storage(env=env)
+
+
+class TestEventsContract:
+    def test_insert_get_delete(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        eid = ev.insert(mk(), APP)
+        got = ev.get(eid, APP)
+        assert got is not None and got.event_id == eid and got.entity_id == "u1"
+        assert ev.delete(eid, APP) is True
+        assert ev.delete(eid, APP) is False
+        assert ev.get(eid, APP) is None
+
+    def test_insert_batch(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        ids = ev.insert_batch([mk(minute=i) for i in range(5)], APP)
+        assert len(set(ids)) == 5
+        assert len(list(ev.find(APP))) == 5
+
+    def test_channel_isolation(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        ev.init(APP, 7)
+        ev.insert(mk(entity_id="default"), APP)
+        ev.insert(mk(entity_id="ch"), APP, 7)
+        assert [e.entity_id for e in ev.find(APP)] == ["default"]
+        assert [e.entity_id for e in ev.find(APP, 7)] == ["ch"]
+        ev.remove(APP, 7)
+        assert list(ev.find(APP, 7)) == []
+        assert [e.entity_id for e in ev.find(APP)] == ["default"]
+
+    def test_app_isolation(self, storage):
+        ev = storage.get_events()
+        ev.init(1)
+        ev.init(2)
+        ev.insert(mk(entity_id="a1"), 1)
+        ev.insert(mk(entity_id="a2"), 2)
+        assert [e.entity_id for e in ev.find(1)] == ["a1"]
+        assert ev.get(next(ev.find(2)).event_id, 1) is None
+
+    def test_find_time_range_and_order(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        for m in (3, 1, 2, 0):
+            ev.insert(mk(entity_id=f"u{m}", minute=m), APP)
+        got = [e.entity_id for e in ev.find(APP, start_time=t(1), until_time=t(3))]
+        assert got == ["u1", "u2"]  # ascending, start inclusive, until exclusive
+        rev = [e.entity_id for e in ev.find(APP, reversed_=True)]
+        assert rev == ["u3", "u2", "u1", "u0"]
+        limited = [e.entity_id for e in ev.find(APP, limit=2)]
+        assert limited == ["u0", "u1"]
+
+    def test_find_filters(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        ev.insert(mk(event="rate", entity_id="u1", target="i1"), APP)
+        ev.insert(mk(event="buy", entity_id="u1", target="i2", minute=1), APP)
+        ev.insert(mk(event="$set", entity_id="u2", minute=2,
+                     props={"a": 1}), APP)
+        assert len(list(ev.find(APP, event_names=["rate"]))) == 1
+        assert len(list(ev.find(APP, event_names=["rate", "buy"]))) == 2
+        assert len(list(ev.find(APP, entity_id="u1"))) == 2
+        assert len(list(ev.find(APP, entity_type="user"))) == 3
+        assert len(list(ev.find(APP, target_entity_id="i2"))) == 1
+        # Some(None)-style filter: only events with NO target entity
+        none_target = list(ev.find(APP, target_entity_type=NONE_FILTER))
+        assert [e.entity_id for e in none_target] == ["u2"]
+
+    def test_aggregate_properties_through_backend(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        ev.insert(mk(event="$set", entity_id="u1", props={"a": 1, "b": 2}), APP)
+        ev.insert(mk(event="$unset", entity_id="u1", minute=1, props={"a": 0}), APP)
+        ev.insert(mk(event="$set", entity_id="u2", minute=1, props={"c": 9}), APP)
+        ev.insert(mk(event="$delete", entity_id="u3", minute=1), APP)
+        out = ev.aggregate_properties(APP, entity_type="user")
+        assert out["u1"].to_dict() == {"b": 2}
+        assert out["u2"].to_dict() == {"c": 9}
+        assert "u3" not in out
+        req = ev.aggregate_properties(APP, entity_type="user", required=["c"])
+        assert set(req) == {"u2"}
+        single = ev.aggregate_properties_of_entity(
+            APP, entity_type="user", entity_id="u1")
+        assert single.to_dict() == {"b": 2}
+
+    def test_event_document_fidelity(self, storage):
+        ev = storage.get_events()
+        ev.init(APP)
+        original = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i9",
+            properties=DataMap({"rating": 4.5, "nested": {"x": [1, 2]}}),
+            event_time=t(5), tags=["t1", "t2"], pr_id="pr7",
+        )
+        eid = ev.insert(original, APP)
+        got = ev.get(eid, APP)
+        assert got.properties.to_dict() == {"rating": 4.5, "nested": {"x": [1, 2]}}
+        assert list(got.tags) == ["t1", "t2"] and got.pr_id == "pr7"
+        assert got.event_time == t(5)
+
+
+class TestMetadataContract:
+    def test_apps(self, storage):
+        apps = storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id and apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp", None)) is None  # duplicate name
+        second = apps.insert(App(0, "other", None))
+        assert second != app_id
+        assert {a.name for a in apps.get_all()} == {"myapp", "other"}
+        apps.update(App(app_id, "renamed", None))
+        assert apps.get(app_id).name == "renamed"
+        apps.delete(second)
+        assert apps.get(second) is None
+
+    def test_access_keys(self, storage):
+        keys = storage.get_meta_data_access_keys()
+        k = keys.insert(AccessKey("", 1, ["rate"]))
+        assert k and len(k) == 64
+        assert keys.get(k).events == ("rate",)
+        k2 = keys.insert(AccessKey("explicit", 2, []))
+        assert k2 == "explicit"
+        assert {x.key for x in keys.get_by_appid(2)} == {"explicit"}
+        keys.delete(k)
+        assert keys.get(k) is None
+
+    def test_channels(self, storage):
+        chans = storage.get_meta_data_channels()
+        cid = chans.insert(Channel(0, "ch-1", 1))
+        assert chans.get(cid).name == "ch-1"
+        assert [c.id for c in chans.get_by_appid(1)] == [cid]
+        with pytest.raises(ValueError):
+            Channel(0, "bad name!", 1)
+        with pytest.raises(ValueError):
+            Channel(0, "x" * 17, 1)
+        chans.delete(cid)
+        assert chans.get(cid) is None
+
+    def test_engine_instances(self, storage):
+        eis = storage.get_meta_data_engine_instances()
+        def inst(iid, status, minute):
+            return EngineInstance(
+                id=iid, status=status, start_time=t(minute), end_time=t(minute),
+                engine_id="e", engine_version="1", engine_variant="v",
+                engine_factory="f")
+        i1 = eis.insert(inst("", "INIT", 0))
+        eis.update(EngineInstance(**{**eis.get(i1).__dict__, "status": "COMPLETED"}))
+        i2 = eis.insert(inst("", "COMPLETED", 5))
+        eis.insert(inst("", "INIT", 9))
+        latest = eis.get_latest_completed("e", "1", "v")
+        assert latest.id == i2  # later start_time wins
+        assert len(eis.get_completed("e", "1", "v")) == 2
+        assert eis.get_latest_completed("e", "1", "other") is None
+        eis.delete(i1)
+        assert eis.get(i1) is None
+
+    def test_evaluation_instances(self, storage):
+        evis = storage.get_meta_data_evaluation_instances()
+        i1 = evis.insert(EvaluationInstance(status="INIT", start_time=t(0)))
+        evis.update(EvaluationInstance(
+            **{**evis.get(i1).__dict__, "status": "EVALCOMPLETED",
+               "evaluator_results": "score=1"}))
+        assert evis.get_completed()[0].evaluator_results == "score=1"
+        assert evis.get(i1).status == "EVALCOMPLETED"
+
+    def test_models(self, storage):
+        models = storage.get_model_data_models()
+        models.insert(Model("m1", b"\x00\x01binary\xff"))
+        assert models.get("m1").models == b"\x00\x01binary\xff"
+        models.delete("m1")
+        assert models.get("m1") is None
+
+    def test_verify_all_data_objects(self, storage):
+        storage.verify_all_data_objects()
+
+
+def test_localfs_models(tmp_path):
+    env = {
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+    }
+    storage = Storage(env=env)
+    models = storage.get_model_data_models()
+    models.insert(Model("abc", b"hello"))
+    assert models.get("abc").models == b"hello"
+    assert models.get("missing") is None
+    models.delete("abc")
+    assert models.get("abc") is None
+
+
+def test_default_env_uses_sqlite(tmp_path, monkeypatch):
+    storage = Storage(env={"PIO_FS_BASEDIR": str(tmp_path / "store")})
+    storage.verify_all_data_objects()
+    assert (tmp_path / "store" / "pio.sqlite").exists()

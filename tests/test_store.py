@@ -1,0 +1,103 @@
+"""Store façades + columnar TPU ingestion (ref: data/.../store/)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def app(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "testapp", None))
+    memory_storage.get_events().init(app_id)
+    return app_id
+
+
+def rate(u, i, r, minute=0):
+    import datetime as dt
+    return Event(
+        event="rate", entity_type="user", entity_id=u,
+        target_entity_type="item", target_entity_id=i,
+        properties=DataMap({"rating": r}),
+        event_time=dt.datetime(2021, 1, 1, 0, minute, tzinfo=dt.timezone.utc),
+    )
+
+
+def test_find_by_app_name(memory_storage, app):
+    store.write([rate("u1", "i1", 4.0)], app)
+    got = list(store.find("testapp"))
+    assert len(got) == 1 and got[0].entity_id == "u1"
+    with pytest.raises(store.StoreError):
+        list(store.find("nonexistent"))
+
+
+def test_channel_resolution(memory_storage, app):
+    cid = memory_storage.get_meta_data_channels().insert(Channel(0, "mobile", app))
+    memory_storage.get_events().init(app, cid)
+    store.write([rate("u9", "i9", 1.0)], app, cid)
+    got = list(store.find("testapp", channel_name="mobile"))
+    assert [e.entity_id for e in got] == ["u9"]
+    assert list(store.find("testapp")) == []
+    with pytest.raises(store.StoreError):
+        list(store.find("testapp", channel_name="nope"))
+
+
+def test_find_by_entity_latest_first(memory_storage, app):
+    store.write([rate("u1", "i1", 1.0, minute=0),
+                 rate("u1", "i2", 2.0, minute=1),
+                 rate("u2", "i3", 3.0, minute=2)], app)
+    got = store.find_by_entity("testapp", "user", "u1", limit=1)
+    assert len(got) == 1 and got[0].target_entity_id == "i2"  # latest
+
+
+def test_aggregate_properties_facade(memory_storage, app):
+    import datetime as dt
+    store.write([
+        Event(event="$set", entity_type="item", entity_id="i1",
+              properties=DataMap({"cat": "a"}),
+              event_time=dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)),
+    ], app)
+    out = store.aggregate_properties("testapp", "item")
+    assert out["i1"].get_str("cat") == "a"
+
+
+def test_find_columnar(memory_storage, app):
+    store.write([
+        rate("u1", "i1", 4.0, 0),
+        rate("u2", "i1", 3.0, 1),
+        rate("u1", "i2", 5.0, 2),
+    ], app)
+    col = store.find_columnar("testapp", event_names=["rate"])
+    assert col.n == 3
+    assert len(col.entity_ids) == 2 and len(col.target_ids) == 2
+    u1, i1 = col.entity_ids("u1"), col.target_ids("i1")
+    np.testing.assert_array_equal(col.entity_idx[:2], [u1, col.entity_ids("u2")])
+    assert col.target_idx[0] == i1
+    np.testing.assert_allclose(col.rating, [4.0, 3.0, 5.0])
+    assert col.event_names == ["rate"]
+    assert col.entity_idx.dtype == np.int32
+
+
+def test_find_columnar_fixed_vocab_drops_unseen(memory_storage, app):
+    store.write([rate("u1", "i1", 4.0), rate("uX", "i1", 2.0, 1)], app)
+    vocab = BiMap.string_int(["u1"])
+    col = store.find_columnar("testapp", event_names=["rate"],
+                              entity_vocab=vocab)
+    assert col.n == 1  # uX dropped under fixed vocab
+    assert col.entity_ids is vocab
+
+
+def test_find_columnar_missing_rating_nan(memory_storage, app):
+    import datetime as dt
+    store.write([
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)),
+    ], app)
+    col = store.find_columnar("testapp", event_names=["view"])
+    assert np.isnan(col.rating[0])
