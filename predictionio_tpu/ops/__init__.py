@@ -1,0 +1,6 @@
+"""TPU compute kernels (XLA; Pallas where profiling warrants).
+
+This package is the TPU-native replacement for the Spark MLlib calls the
+reference delegates to (SURVEY.md §2 "Language note"): ALS
+(explicit + implicit), multinomial NaiveBayes, and masked top-K scoring.
+"""

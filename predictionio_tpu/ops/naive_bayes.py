@@ -1,0 +1,70 @@
+"""Multinomial Naive Bayes on TPU.
+
+Replaces `org.apache.spark.mllib.classification.NaiveBayes.train(lambda)` as
+invoked by the classification template (examples/scala-parallel-classification/
+add-algorithm/src/main/scala/NaiveBayesAlgorithm.scala:28-45).
+
+MLlib's multinomial NB model is: pi_c = log((N_c + lambda) / (N + C*lambda)),
+theta_cj = log((sum of feature j over class c + lambda) /
+               (sum of all features over class c + D*lambda)).
+Training here is two segment-sums over the label axis (one for class counts,
+one for per-class feature sums — a (C, n) one-hot x (n, D) matmul shape XLA
+maps to the MXU) and a couple of log ops; prediction is a single (b, D) x
+(D, C) matmul + argmax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class NaiveBayesModel:
+    pi: jnp.ndarray      # (C,) log class priors
+    theta: jnp.ndarray   # (C, D) log feature likelihoods
+    n_classes: int
+
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def _train(features, labels, lambda_, n_classes: int):
+    n, d = features.shape
+    onehot = jax.nn.one_hot(labels, n_classes, dtype=features.dtype)  # (n, C)
+    class_counts = jnp.sum(onehot, axis=0)                            # (C,)
+    feat_sums = onehot.T @ features                                   # (C, D) MXU
+    pi = jnp.log(class_counts + lambda_) - jnp.log(
+        jnp.sum(class_counts) + n_classes * lambda_)
+    theta = jnp.log(feat_sums + lambda_) - jnp.log(
+        jnp.sum(feat_sums, axis=1, keepdims=True) + d * lambda_)
+    return pi, theta
+
+
+def train(features, labels, lambda_: float = 1.0,
+          n_classes: int | None = None) -> NaiveBayesModel:
+    """features (n, D) non-negative counts; labels (n,) int in [0, C)."""
+    features = jnp.asarray(features, dtype=jnp.float32)
+    labels = jnp.asarray(labels, dtype=jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(labels)) + 1
+    pi, theta = _train(features, labels, jnp.float32(lambda_), n_classes)
+    return NaiveBayesModel(pi=pi, theta=theta, n_classes=n_classes)
+
+
+@jax.jit
+def log_joint(model_pi, model_theta, features) -> jnp.ndarray:
+    """(b, D) -> (b, C) unnormalized log p(c | x)."""
+    return features @ model_theta.T + model_pi[None, :]
+
+
+def predict(model: NaiveBayesModel, features) -> jnp.ndarray:
+    features = jnp.atleast_2d(jnp.asarray(features, dtype=jnp.float32))
+    return jnp.argmax(log_joint(model.pi, model.theta, features), axis=1)
+
+
+def predict_proba(model: NaiveBayesModel, features) -> jnp.ndarray:
+    features = jnp.atleast_2d(jnp.asarray(features, dtype=jnp.float32))
+    return jax.nn.softmax(log_joint(model.pi, model.theta, features), axis=1)

@@ -1,0 +1,9 @@
+"""Mesh + sharding utilities — the TPU-native replacement for Spark's
+shuffle/broadcast/executor topology (SURVEY.md §2.7).
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    get_mesh, local_device_count, pad_to_multiple, shard_rows,
+)
+
+__all__ = ["get_mesh", "local_device_count", "pad_to_multiple", "shard_rows"]

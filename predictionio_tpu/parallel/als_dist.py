@@ -1,0 +1,154 @@
+"""Block-sharded ALS over a 1-D device mesh.
+
+The TPU-native replacement for MLlib ALS's block-to-block shuffle
+(SURVEY.md §2.7 "Model (block) parallelism"): users and items are split into
+contiguous blocks, one block per device. Each half-iteration is entirely
+local — a device solves its own user (item) block against a replicated copy
+of the opposite factors — followed by ONE tiled all-gather over the mesh
+axis to re-replicate the freshly solved side. Collectives ride ICI; no
+scatter/shuffle ever crosses devices.
+
+Factor-exchange volume per iteration = |U| + |V| floats (two all-gathers),
+versus MLlib's per-iteration shuffle of factor blocks + ratings join.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial  # noqa: F401
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.als import (
+    ALSData, COOSide, _half_step_explicit, _half_step_implicit, init_factors,
+)
+
+
+@dataclass
+class ShardedSide:
+    """One orientation of the ratings, laid out for n_dev devices.
+
+    Flat arrays are (n_dev * nnz_dev,) so a P("block") spec gives each
+    device a (nnz_dev,) slice; self indices are block-local; counts are
+    (n_dev * rows_dev,). Padding rows use local index rows_dev.
+    """
+    self_idx: np.ndarray
+    other_idx: np.ndarray
+    rating: np.ndarray
+    counts: np.ndarray
+    rows_dev: int       # rows (users or items) per device, padded
+    nnz_dev: int        # ratings per device, padded
+    n_rows_pad: int     # rows_dev * n_dev
+
+
+def _shard_side(side: COOSide, n_dev: int, chunk: int) -> ShardedSide:
+    rows_dev = -(-side.n_self // n_dev)          # ceil
+    n_rows_pad = rows_dev * n_dev
+    # ratings are sorted by self_idx; block boundaries via searchsorted
+    bounds = np.searchsorted(
+        side.self_idx, np.arange(0, n_rows_pad + 1, rows_dev))
+    nnz_dev = int(max((bounds[1:] - bounds[:-1]).max(), 1))
+    nnz_dev = ((nnz_dev + chunk - 1) // chunk) * chunk
+    s = np.full((n_dev, nnz_dev), rows_dev, dtype=np.int32)  # pad = local n_self
+    o = np.zeros((n_dev, nnz_dev), dtype=np.int32)
+    r = np.zeros((n_dev, nnz_dev), dtype=np.float32)
+    for d in range(n_dev):
+        lo, hi = bounds[d], bounds[d + 1]
+        m = hi - lo
+        s[d, :m] = side.self_idx[lo:hi] - d * rows_dev
+        o[d, :m] = side.other_idx[lo:hi]
+        r[d, :m] = side.rating[lo:hi]
+    counts = np.zeros(n_rows_pad, dtype=np.int32)
+    counts[: side.n_self] = side.counts
+    return ShardedSide(
+        self_idx=s.reshape(-1), other_idx=o.reshape(-1), rating=r.reshape(-1),
+        counts=counts, rows_dev=rows_dev, nnz_dev=nnz_dev,
+        n_rows_pad=n_rows_pad,
+    )
+
+
+def prepare_sharded(data: ALSData, n_dev: int,
+                    chunk: int = 1 << 16) -> Tuple[ShardedSide, ShardedSide]:
+    return (_shard_side(data.by_user, n_dev, chunk),
+            _shard_side(data.by_item, n_dev, chunk))
+
+
+def train_explicit_sharded(
+    mesh: Mesh,
+    data: ALSData,
+    rank: int = 10,
+    iterations: int = 10,
+    lambda_: float = 0.01,
+    seed: int = 3,
+    chunk: int = 1 << 16,
+    reg_scaling: str = "count",
+    implicit: bool = False,
+    alpha: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full training step sharded over `mesh`'s single axis.
+
+    Returns (U (n_users_pad, rank), V (n_items_pad, rank)) laid out
+    row-sharded over the mesh; slice [:n_users]/[:n_items] on host if the
+    padding rows matter.
+    """
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    su, si = prepare_sharded(data, n_dev, chunk)
+    half = _half_step_implicit if implicit else _half_step_explicit
+
+    def half_kwargs():
+        return dict(chunk=chunk, reg_scaling=reg_scaling)
+
+    def step_fn(us, uo, ur, uc, is_, io, ir, ic, ku, ki):
+        # Everything below runs per-device on (nnz_dev,) local slices.
+        dev = lax.axis_index(axis)
+        U_blk = init_factors(jax.random.fold_in(ku, dev), su.rows_dev, rank)
+        U = lax.all_gather(U_blk, axis, tiled=True)
+        V_blk = init_factors(jax.random.fold_in(ki, dev), si.rows_dev, rank)
+        V = lax.all_gather(V_blk, axis, tiled=True)
+
+        def one_iter(_, UV):
+            U, V = UV
+            if implicit:
+                U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_, alpha,
+                             **half_kwargs())
+            else:
+                U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_,
+                             **half_kwargs())
+            U = lax.all_gather(U_blk, axis, tiled=True)
+            if implicit:
+                V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_, alpha,
+                             **half_kwargs())
+            else:
+                V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_,
+                             **half_kwargs())
+            V = lax.all_gather(V_blk, axis, tiled=True)
+            return (U, V)
+
+        U, V = lax.fori_loop(0, iterations, one_iter, (U, V))
+        # return row-sharded blocks: slice this device's rows back out
+        idx = lax.axis_index(axis)
+        U_blk = lax.dynamic_slice_in_dim(U, idx * su.rows_dev, su.rows_dev)
+        V_blk = lax.dynamic_slice_in_dim(V, idx * si.rows_dev, si.rows_dev)
+        return U_blk, V_blk
+
+    sharded = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis), P(axis), P(axis), P(), P()),
+        out_specs=(P(axis, None), P(axis, None)),
+        check_vma=False,
+    )
+
+    jitted = jax.jit(sharded)
+    ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+    args = (su.self_idx, su.other_idx, su.rating, su.counts,
+            si.self_idx, si.other_idx, si.rating, si.counts)
+    spec = NamedSharding(mesh, P(axis))
+    args = tuple(jax.device_put(a, spec) for a in args)
+    return jitted(*args, ku, ki)

@@ -1,0 +1,81 @@
+"""Device-mesh helpers.
+
+The reference scales by adding Spark executors and shuffling RDD partitions
+between them (SURVEY.md §2.7). Here the unit of scale is a
+`jax.sharding.Mesh` over TPU devices: data/model axes are sharded over ICI
+and XLA inserts the collectives. These helpers centralize mesh creation and
+host-side padding/partitioning for block-sharded kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def get_mesh(n_devices: Optional[int] = None,
+             axis_name: str = "block") -> Mesh:
+    """A 1-D mesh over the first n devices (default: all).
+
+    ALS and the other classical-ML kernels here are block-parallel over one
+    axis (users or items); a 1-D mesh suffices and maps onto an ICI ring.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} "
+                "are visible")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, pad_value) -> np.ndarray:
+    """Pad axis 0 up to a multiple (XLA static-shape friendliness)."""
+    n = arr.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple if n else multiple
+    if target == n:
+        return arr
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=pad_value)
+
+
+def shard_rows(
+    sizes: Sequence[int], n_shards: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Partition `len(sizes)` contiguous row-groups into n_shards contiguous
+    blocks, balancing total size greedily.
+
+    Returns (block_start, block_end) index arrays of length n_shards over the
+    group axis. Used to split sorted-by-user ratings into per-device blocks.
+    """
+    sizes = np.asarray(sizes, dtype=np.int64)
+    n_groups = len(sizes)
+    total = int(sizes.sum())
+    target = total / max(n_shards, 1)
+    starts = np.zeros(n_shards, dtype=np.int64)
+    ends = np.zeros(n_shards, dtype=np.int64)
+    cum = np.concatenate([[0], np.cumsum(sizes)])
+    g = 0
+    for s in range(n_shards):
+        starts[s] = g
+        if s == n_shards - 1:
+            g = n_groups
+        else:
+            # advance until this shard's load reaches the even target
+            goal = (s + 1) * target
+            while g < n_groups and cum[g + 1] <= goal:
+                g += 1
+            # always make progress if groups remain and later shards can
+            # still be non-empty
+            if g == starts[s] and g < n_groups - (n_shards - s - 1):
+                g += 1
+        ends[s] = g
+    return starts, ends

@@ -7,11 +7,17 @@ built in tests span 8 virtual CPU devices.
 
 import os
 
+# jax is preloaded by the environment's sitecustomize, so plain env vars are
+# too late — but the backend is not initialized yet, so config still applies.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,84 @@
+"""Block-sharded ALS on an 8-device virtual mesh (SURVEY.md §4: the CPU
+XLA_FLAGS-device-count analogue of the reference's Spark local[*] testing)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.parallel import als_dist
+from predictionio_tpu.parallel.mesh import get_mesh, shard_rows
+
+
+def make_problem(n_u=60, n_i=40, rank=4, seed=0):
+    rng = np.random.default_rng(seed)
+    U0 = rng.normal(size=(n_u, rank))
+    V0 = rng.normal(size=(n_i, rank))
+    R = U0 @ V0.T
+    mask = rng.random((n_u, n_i)) < 0.6
+    ui, ii = np.nonzero(mask)
+    return ui.astype(np.int32), ii.astype(np.int32), R[ui, ii].astype(np.float32)
+
+
+def test_shard_side_partitioning():
+    ui, ii, vals = make_problem()
+    data = als.prepare_ratings(ui, ii, vals, 60, 40, chunk=16)
+    su, si = als_dist.prepare_sharded(data, n_dev=4, chunk=16)
+    assert su.n_rows_pad == 60 and su.rows_dev == 15
+    assert su.self_idx.shape[0] == 4 * su.nnz_dev
+    # every real entry preserved exactly once, with local indices in range
+    s = su.self_idx.reshape(4, su.nnz_dev)
+    r = su.rating.reshape(4, su.nnz_dev)
+    real = s < su.rows_dev
+    assert int(real.sum()) == data.nnz
+    for d in range(4):
+        local = s[d][real[d]]
+        assert local.min() >= 0 and local.max() < su.rows_dev
+    # ratings sum preserved
+    np.testing.assert_allclose(r.sum(), vals.sum(), rtol=1e-5)
+
+
+def test_sharded_training_converges(n_dev=8):
+    ui, ii, vals = make_problem(seed=1)
+    data = als.prepare_ratings(ui, ii, vals, 60, 40, chunk=64)
+    mesh = get_mesh(n_dev)
+    U, V = als_dist.train_explicit_sharded(
+        mesh, data, rank=4, iterations=15, lambda_=1e-6, chunk=64)
+    U, V = np.asarray(U)[:60], np.asarray(V)[:40]
+    pred = np.sum(U[ui] * V[ii], axis=1)
+    assert np.sqrt(np.mean((pred - vals) ** 2)) < 1e-3
+
+
+def test_sharded_implicit_runs():
+    ui, ii, vals = make_problem(seed=2)
+    data = als.prepare_ratings(ui, ii, np.abs(vals) + 1, 60, 40, chunk=64)
+    mesh = get_mesh(8)
+    U, V = als_dist.train_explicit_sharded(
+        mesh, data, rank=4, iterations=3, lambda_=0.05, chunk=64,
+        implicit=True, alpha=10.0)
+    assert np.isfinite(np.asarray(U)).all() and np.isfinite(np.asarray(V)).all()
+
+
+def test_sharded_matches_quality_of_single_device():
+    """Same data, same hyperparams: sharded must reach the quality of the
+    single-device solve (different init, so compare fit, not values)."""
+    ui, ii, vals = make_problem(seed=3)
+    data = als.prepare_ratings(ui, ii, vals, 60, 40, chunk=64)
+    U1, V1 = als.train_explicit(data, rank=4, iterations=10, lambda_=0.01,
+                                chunk=64)
+    pred1 = np.sum(np.asarray(U1)[ui] * np.asarray(V1)[ii], axis=1)
+    rmse1 = np.sqrt(np.mean((pred1 - vals) ** 2))
+
+    mesh = get_mesh(8)
+    U2, V2 = als_dist.train_explicit_sharded(
+        mesh, data, rank=4, iterations=10, lambda_=0.01, chunk=64)
+    pred2 = np.sum(np.asarray(U2)[:60][ui] * np.asarray(V2)[:40][ii], axis=1)
+    rmse2 = np.sqrt(np.mean((pred2 - vals) ** 2))
+    assert rmse2 < rmse1 * 1.5 + 1e-3
+
+
+def test_shard_rows_balancing():
+    starts, ends = shard_rows([10, 1, 1, 10, 1, 1, 10, 2], 4)
+    assert starts[0] == 0 and ends[-1] == 8
+    # contiguous, non-overlapping, covering
+    for s in range(1, 4):
+        assert starts[s] == ends[s - 1]

@@ -1,0 +1,50 @@
+"""Multinomial NB parity with the MLlib formulation (classification template)."""
+
+import numpy as np
+
+from predictionio_tpu.ops import naive_bayes as nb
+
+
+def test_closed_form_parity():
+    X = np.array([[1, 0, 2], [2, 1, 0], [0, 3, 1], [1, 1, 1]], dtype=np.float32)
+    y = np.array([0, 0, 1, 1], dtype=np.int32)
+    lam = 1.0
+    model = nb.train(X, y, lambda_=lam)
+
+    for c in range(2):
+        sel = y == c
+        expected_pi = np.log((sel.sum() + lam) / (len(y) + 2 * lam))
+        np.testing.assert_allclose(float(model.pi[c]), expected_pi, rtol=1e-5)
+        fsum = X[sel].sum(axis=0)
+        expected_theta = np.log((fsum + lam) / (fsum.sum() + 3 * lam))
+        np.testing.assert_allclose(np.asarray(model.theta)[c], expected_theta,
+                                   rtol=1e-5)
+
+
+def test_predict_separable():
+    rng = np.random.default_rng(0)
+    # class 0 heavy on features 0-1, class 1 heavy on features 2-3
+    n = 200
+    X0 = rng.poisson([5, 5, 0.5, 0.5], size=(n, 4))
+    X1 = rng.poisson([0.5, 0.5, 5, 5], size=(n, 4))
+    X = np.vstack([X0, X1]).astype(np.float32)
+    y = np.array([0] * n + [1] * n, dtype=np.int32)
+    model = nb.train(X, y, lambda_=1.0)
+    acc = (np.asarray(nb.predict(model, X)) == y).mean()
+    assert acc > 0.95
+
+
+def test_predict_proba_normalized():
+    X = np.array([[1.0, 2.0]], dtype=np.float32)
+    model = nb.train(np.array([[1, 0], [0, 1]], dtype=np.float32),
+                     np.array([0, 1], dtype=np.int32))
+    p = np.asarray(nb.predict_proba(model, X))
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-6)
+    assert p.shape == (1, 2)
+
+
+def test_single_sample_predict():
+    model = nb.train(np.array([[3, 0], [0, 3]], dtype=np.float32),
+                     np.array([0, 1], dtype=np.int32))
+    assert int(nb.predict(model, np.array([5.0, 0.0]))[0]) == 0
+    assert int(nb.predict(model, np.array([0.0, 5.0]))[0]) == 1

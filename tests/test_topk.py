@@ -1,0 +1,40 @@
+"""Top-K scoring ops (serving hot path)."""
+
+import numpy as np
+
+from predictionio_tpu.ops import topk
+
+
+def test_topk_scores_basic():
+    V = np.array([[1.0, 0], [0, 1], [2, 0], [0.5, 0.5]], dtype=np.float32)
+    q = np.array([1.0, 0.0], dtype=np.float32)
+    vals, idx = topk.topk_scores(q, V, k=2)
+    np.testing.assert_array_equal(np.asarray(idx), [2, 0])
+    np.testing.assert_allclose(np.asarray(vals), [2.0, 1.0])
+
+
+def test_topk_scores_mask_excludes():
+    V = np.array([[1.0, 0], [0, 1], [2, 0], [0.5, 0.5]], dtype=np.float32)
+    q = np.array([1.0, 0.0], dtype=np.float32)
+    mask = np.array([True, True, False, True])  # best item excluded
+    vals, idx = topk.topk_scores(q, V, mask, k=2)
+    np.testing.assert_array_equal(np.asarray(idx), [0, 3])
+
+
+def test_topk_batch_matches_loop():
+    rng = np.random.default_rng(0)
+    V = rng.normal(size=(50, 8)).astype(np.float32)
+    Q = rng.normal(size=(7, 8)).astype(np.float32)
+    bv, bi = topk.topk_scores_batch(Q, V, k=5)
+    for row in range(7):
+        sv, si = topk.topk_scores(Q[row], V, k=5)
+        np.testing.assert_array_equal(np.asarray(bi)[row], np.asarray(si))
+
+
+def test_cosine_topk_scale_invariant():
+    V = np.array([[10.0, 0], [0, 0.1], [3, 3]], dtype=np.float32)
+    q = np.array([5.0, 0.0], dtype=np.float32)
+    vals, idx = topk.cosine_topk(q, V, k=3)
+    # cosine ignores magnitude: item0 (parallel) wins with score 1
+    assert int(np.asarray(idx)[0]) == 0
+    np.testing.assert_allclose(float(np.asarray(vals)[0]), 1.0, rtol=1e-5)
