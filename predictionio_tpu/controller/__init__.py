@@ -1,0 +1,45 @@
+"""The user-facing DASE SDK (reference: core/.../controller/).
+
+An engine is four user classes — DataSource, Preparator, Algorithm(s),
+Serving — plus typed Params, wired by an Engine and configured by
+engine.json. The Spark P/L split (RDD-distributed vs local) collapses in the
+single-controller TPU runtime: every component is host Python orchestrating
+device arrays, so there is ONE base class per role, with P*/L* aliases kept
+for migration parity.
+"""
+
+from predictionio_tpu.controller.base import (
+    Algorithm, DataSource, EmptyActualResult, EmptyEvaluationInfo, EmptyParams,
+    Params, Preparator, SanityCheck, Serving,
+    PAlgorithm, P2LAlgorithm, LAlgorithm, PDataSource, LDataSource,
+    PPreparator, LPreparator, LServing,
+)
+from predictionio_tpu.controller.engine import (
+    Engine, EngineParams, SimpleEngine, engine_params_from_json,
+)
+from predictionio_tpu.controller.identity import (
+    AverageServing, FirstServing, IdentityPreparator,
+)
+from predictionio_tpu.controller.metric import (
+    AverageMetric, Metric, OptionAverageMetric, OptionStdevMetric, StdevMetric,
+    SumMetric, ZeroMetric,
+)
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator, Evaluation, MetricEvaluator, MetricScores,
+)
+from predictionio_tpu.controller.persistent_model import (
+    LocalFileSystemPersistentModel, PersistentModel,
+)
+
+__all__ = [
+    "Algorithm", "DataSource", "EmptyActualResult", "EmptyEvaluationInfo",
+    "EmptyParams", "Params", "Preparator", "SanityCheck", "Serving",
+    "PAlgorithm", "P2LAlgorithm", "LAlgorithm", "PDataSource", "LDataSource",
+    "PPreparator", "LPreparator", "LServing",
+    "Engine", "EngineParams", "SimpleEngine", "engine_params_from_json",
+    "AverageServing", "FirstServing", "IdentityPreparator",
+    "AverageMetric", "Metric", "OptionAverageMetric", "OptionStdevMetric",
+    "StdevMetric", "SumMetric", "ZeroMetric",
+    "EngineParamsGenerator", "Evaluation", "MetricEvaluator", "MetricScores",
+    "LocalFileSystemPersistentModel", "PersistentModel",
+]

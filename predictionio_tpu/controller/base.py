@@ -1,0 +1,151 @@
+"""Base DASE component classes.
+
+Reference: core/.../core/{BaseDataSource,BasePreparator,BaseAlgorithm,
+BaseServing}.scala and core/.../controller/{PDataSource,LDataSource,
+PPreparator,LPreparator,PAlgorithm,P2LAlgorithm,LAlgorithm,LServing}.scala.
+
+The `ctx` argument threading through train/eval is a
+:class:`predictionio_tpu.workflow.context.WorkflowContext` — the analogue of
+the SparkContext handle: it owns the device mesh, workflow params, and the
+storage handle.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, Generic, Iterable, List, Optional, Sequence, Tuple, TypeVar
+
+TD = TypeVar("TD")   # training data
+PD = TypeVar("PD")   # prepared data
+Q = TypeVar("Q")     # query
+P = TypeVar("P")     # predicted result
+A = TypeVar("A")     # actual result
+EI = TypeVar("EI")   # evaluation info
+M = TypeVar("M")     # model
+
+
+class Params:
+    """Marker base for typed parameter classes (controller/Params.scala).
+
+    Subclasses should be dataclasses; they are instantiated from engine.json
+    with `cls(**json_params)` (the json4s extraction analogue).
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyEvaluationInfo:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyActualResult:
+    pass
+
+
+class SanityCheck(abc.ABC):
+    """Data classes can opt into train-time checks (controller/SanityCheck)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise if the data is unusable (e.g. empty training set)."""
+
+
+def create_doer(cls, params: Optional[Params]):
+    """Instantiate a DASE class with its Params — 1-arg ctor or 0-arg
+    fallback (core/.../core/AbstractDoer.scala:29-69)."""
+    if params is None or isinstance(params, EmptyParams):
+        try:
+            return cls()
+        except TypeError:
+            return cls(params if params is not None else EmptyParams())
+    return cls(params)
+
+
+class DataSource(Generic[TD, EI, Q, A], abc.ABC):
+    """Reads training / evaluation data (BaseDataSource.scala:34-55)."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx) -> TD: ...
+
+    def read_eval(self, ctx) -> List[Tuple[TD, EI, List[Tuple[Q, A]]]]:
+        """k-fold (TD, EI, [(Q, A)]) sets; default: not implemented for
+        engines that only train (PDataSource.scala:46-56)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_eval; evaluation "
+            "is unavailable for this engine")
+
+
+class Preparator(Generic[TD, PD], abc.ABC):
+    """TD -> PD (BasePreparator.scala:33-45)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx, training_data: TD) -> PD: ...
+
+
+class Algorithm(Generic[PD, M, Q, P], abc.ABC):
+    """train/predict pair (BaseAlgorithm.scala:58-126).
+
+    The TPU-native model contract: whatever `train` returns is handed back to
+    `predict` (possibly after a checkpoint round-trip, see
+    make_persistent_model / workflow.model_io). Keep device arrays inside the
+    model; they are converted to host arrays at persistence time and
+    device_put back at deploy.
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P: ...
+
+    def batch_predict(self, model: M,
+                      queries: Iterable[Tuple[int, Q]]) -> List[Tuple[int, P]]:
+        """Used by evaluation. Default mirrors P2LAlgorithm.batchPredict
+        (P2LAlgorithm.scala:69-71): map predict over queries. Override with a
+        device-batched implementation for throughput.
+        """
+        return [(qx, self.predict(model, q)) for qx, q in queries]
+
+    # -- persistence hooks (BaseAlgorithm.makePersistentModel) --------------
+    def make_persistent_model(self, ctx, model: M) -> Any:
+        """Return the object to persist for this model; default the model
+        itself. Return a PersistentModelManifest-like marker for
+        self-managed saves (controller/PersistentModel.scala)."""
+        return model
+
+    @property
+    def query_class(self):
+        """Optional override: the Query dataclass for JSON extraction."""
+        return None
+
+
+class Serving(Generic[Q, P], abc.ABC):
+    """Query supplement + prediction combination (BaseServing.scala:31-54)."""
+
+    def supplement(self, query: Q) -> Q:
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
+
+
+# ---------------------------------------------------------------------------
+# Reference-parity aliases. The P*/L* distinction encoded WHERE data lived
+# (Spark executors vs driver). With a single-controller runtime + device
+# arrays the distinction is moot; aliases keep template code 1:1 portable.
+# ---------------------------------------------------------------------------
+
+PDataSource = DataSource
+LDataSource = DataSource
+PPreparator = Preparator
+LPreparator = Preparator
+PAlgorithm = Algorithm     # distributed model (PAlgorithm.scala:47-99)
+P2LAlgorithm = Algorithm   # distributed train, local model (P2LAlgorithm.scala)
+LAlgorithm = Algorithm     # local train (LAlgorithm.scala)
+LServing = Serving

@@ -1,0 +1,213 @@
+"""Engine: chains DASE classes; train/eval orchestration.
+
+Reference: core/.../controller/Engine.scala (class :83, train impl :625-712,
+eval impl :730-820, jValueToEngineParams :357-420) and
+core/.../controller/EngineParams.scala:35-160.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+from predictionio_tpu.controller.base import (
+    Algorithm, DataSource, EmptyParams, Params, Preparator, SanityCheck,
+    Serving, create_doer,
+)
+
+logger = logging.getLogger("predictionio_tpu.engine")
+
+
+class StopAfterReadInterruption(Exception):
+    pass
+
+
+class StopAfterPrepareInterruption(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Named parameter bundle for one engine variant
+    (EngineParams.scala:35-128). algorithm_params_list entries are
+    (name, Params) pairs matching Engine.algorithm_class_map keys."""
+    data_source_params: Params = dataclasses.field(default_factory=EmptyParams)
+    preparator_params: Params = dataclasses.field(default_factory=EmptyParams)
+    algorithm_params_list: Tuple[Tuple[str, Params], ...] = ()
+    serving_params: Params = dataclasses.field(default_factory=EmptyParams)
+
+
+def _params_from_json(params_cls: Optional[Type], obj: Dict[str, Any]) -> Params:
+    """JSON object -> typed Params (the json4s `extract` analogue,
+    WorkflowUtils.extractParams, WorkflowUtils.scala:123-151)."""
+    if params_cls is None:
+        if obj:
+            raise ValueError(
+                f"component takes no params but engine.json provides {obj}")
+        return EmptyParams()
+    aliases = getattr(params_cls, "JSON_ALIASES", {})
+    if aliases:
+        obj = {aliases.get(k, k): v for k, v in obj.items()}
+    fields = {f.name for f in dataclasses.fields(params_cls)}
+    unknown = set(obj) - fields
+    if unknown:
+        raise ValueError(
+            f"unknown parameter(s) {sorted(unknown)} for {params_cls.__name__}"
+            f" (accepts {sorted(fields)})")
+    try:
+        return params_cls(**obj)
+    except TypeError as e:
+        raise ValueError(
+            f"invalid params for {params_cls.__name__}: {e}") from None
+
+
+class Engine:
+    """An engine = DataSource + Preparator + Algorithm(s) + Serving classes.
+
+    `params_class` attributes: each component class may declare a
+    `params_class` (a dataclass) used for engine.json extraction; absent
+    means the component takes no params.
+    """
+
+    def __init__(
+        self,
+        data_source_class: Type[DataSource],
+        preparator_class: Type[Preparator],
+        algorithm_class_map: Dict[str, Type[Algorithm]],
+        serving_class: Type[Serving],
+    ):
+        self.data_source_class = data_source_class
+        self.preparator_class = preparator_class
+        self.algorithm_class_map = dict(algorithm_class_map)
+        self.serving_class = serving_class
+
+    # -- instantiation ------------------------------------------------------
+    def _instantiate(self, engine_params: EngineParams):
+        data_source = create_doer(self.data_source_class,
+                                  engine_params.data_source_params)
+        preparator = create_doer(self.preparator_class,
+                                 engine_params.preparator_params)
+        algorithms = []
+        for name, aparams in engine_params.algorithm_params_list:
+            if name not in self.algorithm_class_map:
+                raise KeyError(
+                    f"Unknown algorithm name {name!r}; engine defines "
+                    f"{sorted(self.algorithm_class_map)}")
+            algorithms.append(create_doer(self.algorithm_class_map[name], aparams))
+        serving = create_doer(self.serving_class, engine_params.serving_params)
+        return data_source, preparator, algorithms, serving
+
+    # -- training (Engine.scala:625-712) ------------------------------------
+    def train(self, ctx, engine_params: EngineParams) -> List[Any]:
+        data_source, preparator, algorithms, _ = self._instantiate(engine_params)
+        if not algorithms:
+            raise ValueError("engine_params.algorithm_params_list is empty")
+        params = ctx.workflow_params
+        logger.info("EngineWorkflow.train")
+
+        td = data_source.read_training(ctx)
+        self._sanity_check(td, params)
+        if params.stop_after_read:
+            logger.info("Stopping after read (--stop-after-read)")
+            raise StopAfterReadInterruption()
+
+        pd = preparator.prepare(ctx, td)
+        self._sanity_check(pd, params)
+        if params.stop_after_prepare:
+            logger.info("Stopping after prepare (--stop-after-prepare)")
+            raise StopAfterPrepareInterruption()
+
+        models = [a.train(ctx, pd) for a in algorithms]
+        for m in models:
+            self._sanity_check(m, params)
+        logger.info("EngineWorkflow.train completed")
+        return models
+
+    @staticmethod
+    def _sanity_check(obj, params) -> None:
+        if getattr(params, "skip_sanity_check", False):
+            return
+        if isinstance(obj, SanityCheck):
+            logger.info("%s supports data sanity check. Performing check.",
+                        type(obj).__name__)
+            obj.sanity_check()
+
+    # -- evaluation (Engine.scala:730-820) ----------------------------------
+    def eval(self, ctx, engine_params: EngineParams
+             ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        """Returns [(EI, [(Q, P, A)])] — one entry per fold.
+
+        Per fold: prepare, train every algorithm, batch-predict every
+        algorithm over the supplemented queries, combine per-query
+        predictions with serving.serve (fed the ORIGINAL query, Engine.scala
+        :805 comment parity).
+        """
+        data_source, preparator, algorithms, serving = (
+            self._instantiate(engine_params))
+        params = ctx.workflow_params
+        eval_sets = data_source.read_eval(ctx)
+        out = []
+        for td, ei, qa_list in eval_sets:
+            pd = preparator.prepare(ctx, td)
+            models = [a.train(ctx, pd) for a in algorithms]
+            indexed_q = [(qx, serving.supplement(q))
+                         for qx, (q, _a) in enumerate(qa_list)]
+            # per-algorithm predictions, keyed by query index
+            per_algo: List[Dict[int, Any]] = []
+            for algo, model in zip(algorithms, models):
+                per_algo.append(dict(algo.batch_predict(model, indexed_q)))
+            qpa = []
+            for qx, (q, a) in enumerate(qa_list):
+                ps = [pred[qx] for pred in per_algo]
+                qpa.append((q, serving.serve(q, ps), a))
+            out.append((ei, qpa))
+        del params
+        return out
+
+    # -- engine.json extraction (Engine.scala:357-420) -----------------------
+    def engine_params_from_json(self, variant_json: Dict[str, Any]) -> EngineParams:
+        ds_params = _params_from_json(
+            getattr(self.data_source_class, "params_class", None),
+            (variant_json.get("datasource") or {}).get("params", {}))
+        prep_params = _params_from_json(
+            getattr(self.preparator_class, "params_class", None),
+            (variant_json.get("preparator") or {}).get("params", {}))
+        algo_list = []
+        for entry in variant_json.get("algorithms", []):
+            name = entry.get("name")
+            if name is None:
+                raise ValueError("each algorithms[] entry needs a \"name\"")
+            if name not in self.algorithm_class_map:
+                raise KeyError(
+                    f"engine.json algorithm {name!r} not registered; engine "
+                    f"defines {sorted(self.algorithm_class_map)}")
+            algo_cls = self.algorithm_class_map[name]
+            algo_list.append((name, _params_from_json(
+                getattr(algo_cls, "params_class", None),
+                entry.get("params", {}))))
+        serving_params = _params_from_json(
+            getattr(self.serving_class, "params_class", None),
+            (variant_json.get("serving") or {}).get("params", {}))
+        return EngineParams(
+            data_source_params=ds_params,
+            preparator_params=prep_params,
+            algorithm_params_list=tuple(algo_list),
+            serving_params=serving_params,
+        )
+
+
+def engine_params_from_json(engine: Engine, variant_json) -> EngineParams:
+    if isinstance(variant_json, str):
+        variant_json = json.loads(variant_json)
+    return engine.engine_params_from_json(variant_json)
+
+
+class SimpleEngine(Engine):
+    """One-algorithm sugar (EngineParams.scala:130-160)."""
+
+    def __init__(self, data_source_class, preparator_class, algorithm_class,
+                 serving_class):
+        super().__init__(data_source_class, preparator_class,
+                         {"": algorithm_class}, serving_class)

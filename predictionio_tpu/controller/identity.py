@@ -1,0 +1,51 @@
+"""Stock components: identity preparator, first/average servings.
+
+Reference: core/.../controller/IdentityPreparator.scala:34-93,
+LFirstServing.scala:29-44, LAverageServing.scala:29-44.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from predictionio_tpu.controller.base import Preparator, Serving
+
+
+class IdentityPreparator(Preparator):
+    """PD = TD, unchanged."""
+
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx, training_data):
+        return training_data
+
+
+# Reference-parity aliases (PIdentityPreparator / LIdentityPreparator)
+PIdentityPreparator = IdentityPreparator
+LIdentityPreparator = IdentityPreparator
+
+
+class FirstServing(Serving):
+    """Serves the first algorithm's prediction (LFirstServing.scala:29-44)."""
+
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query, predictions: Sequence):
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Serves the numeric mean of all algorithms' predictions
+    (LAverageServing.scala:29-44)."""
+
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query, predictions: Sequence):
+        return sum(predictions) / len(predictions)
+
+
+LFirstServing = FirstServing
+LAverageServing = AverageServing

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.data.aggregate import (
+    EVENT_NAMES,
     aggregate_properties,
     aggregate_properties_single,
 )
@@ -202,7 +203,7 @@ class Events(abc.ABC):
             app_id=app_id, channel_id=channel_id,
             start_time=start_time, until_time=until_time,
             entity_type=entity_type,
-            event_names=list(aggregate_event_names()),
+            event_names=list(EVENT_NAMES),
         )
         result = aggregate_properties(events)
         if required:
@@ -229,7 +230,7 @@ class Events(abc.ABC):
             app_id=app_id, channel_id=channel_id,
             start_time=start_time, until_time=until_time,
             entity_type=entity_type, entity_id=entity_id,
-            event_names=list(aggregate_event_names()),
+            event_names=list(EVENT_NAMES),
         )
         return aggregate_properties_single(events)
 
@@ -237,11 +238,6 @@ class Events(abc.ABC):
 #: Sentinel expressing the reference's Some(None) target-entity filter —
 #: "only events with NO target entity" (LEvents.scala:176-181).
 NONE_FILTER = "__none__"
-
-
-def aggregate_event_names() -> Sequence[str]:
-    from predictionio_tpu.data.aggregate import EVENT_NAMES
-    return EVENT_NAMES
 
 
 def match_target_filter(value: Optional[str], filt) -> bool:
@@ -254,12 +250,21 @@ def match_target_filter(value: Optional[str], filt) -> bool:
     return value == filt
 
 
+def _utc(t):
+    """Naive bounds are taken as UTC (EventValidation.defaultTimeZone)."""
+    return t.replace(tzinfo=_dt.timezone.utc) if t.tzinfo is None else t
+
+
 def event_matches(
     e: Event,
     start_time=None, until_time=None, entity_type=None, entity_id=None,
     event_names=None, target_entity_type=None, target_entity_id=None,
 ) -> bool:
     """The conjunctive filter every backend implements (LEvents.scala:162-207)."""
+    if start_time is not None:
+        start_time = _utc(start_time)
+    if until_time is not None:
+        until_time = _utc(until_time)
     if start_time is not None and e.event_time < start_time:
         return False
     if until_time is not None and e.event_time >= until_time:

@@ -28,6 +28,12 @@ from predictionio_tpu.data.storage.base import (
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 
+def _ck(channel_id):
+    """The default (None) channel is stored as -1 so it can participate in
+    the (id, app_id, channel_id) primary key."""
+    return -1 if channel_id is None else channel_id
+
+
 def _to_epoch_ms(t: _dt.datetime) -> int:
     if t.tzinfo is None:
         t = t.replace(tzinfo=_dt.timezone.utc)
@@ -87,16 +93,17 @@ class SqliteEvents(_Sqlite, base.Events):
     def _create_tables(self):
         self._exec(
             """CREATE TABLE IF NOT EXISTS events (
-                 id TEXT PRIMARY KEY,
+                 id TEXT NOT NULL,
                  app_id INTEGER NOT NULL,
-                 channel_id INTEGER,
+                 channel_id INTEGER NOT NULL DEFAULT -1,
                  event TEXT NOT NULL,
                  entity_type TEXT NOT NULL,
                  entity_id TEXT NOT NULL,
                  target_entity_type TEXT,
                  target_entity_id TEXT,
                  event_time_ms INTEGER NOT NULL,
-                 doc TEXT NOT NULL)"""
+                 doc TEXT NOT NULL,
+                 PRIMARY KEY (id, app_id, channel_id))"""
         )
         self._exec(
             "CREATE INDEX IF NOT EXISTS idx_events_lookup ON events "
@@ -112,8 +119,8 @@ class SqliteEvents(_Sqlite, base.Events):
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         self._exec(
-            "DELETE FROM events WHERE app_id=? AND channel_id IS ?",
-            (app_id, channel_id),
+            "DELETE FROM events WHERE app_id=? AND channel_id=?",
+            (app_id, _ck(channel_id)),
         )
         return True
 
@@ -127,7 +134,7 @@ class SqliteEvents(_Sqlite, base.Events):
         self._exec(
             "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?)",
             (
-                event_id, app_id, channel_id, stored.event,
+                event_id, app_id, _ck(channel_id), stored.event,
                 stored.entity_type, stored.entity_id,
                 stored.target_entity_type, stored.target_entity_id,
                 _to_epoch_ms(stored.event_time), stored.to_json(),
@@ -143,7 +150,7 @@ class SqliteEvents(_Sqlite, base.Events):
             stored = event.with_event_id(event_id)
             ids.append(event_id)
             rows.append((
-                event_id, app_id, channel_id, stored.event,
+                event_id, app_id, _ck(channel_id), stored.event,
                 stored.entity_type, stored.entity_id,
                 stored.target_entity_type, stored.target_entity_id,
                 _to_epoch_ms(stored.event_time), stored.to_json(),
@@ -157,16 +164,16 @@ class SqliteEvents(_Sqlite, base.Events):
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         rows = self._query(
-            "SELECT doc FROM events WHERE id=? AND app_id=? AND channel_id IS ?",
-            (event_id, app_id, channel_id),
+            "SELECT doc FROM events WHERE id=? AND app_id=? AND channel_id=?",
+            (event_id, app_id, _ck(channel_id)),
         )
         return Event.from_json(rows[0][0], validate=False) if rows else None
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         cur = self._exec(
-            "DELETE FROM events WHERE id=? AND app_id=? AND channel_id IS ?",
-            (event_id, app_id, channel_id),
+            "DELETE FROM events WHERE id=? AND app_id=? AND channel_id=?",
+            (event_id, app_id, _ck(channel_id)),
         )
         return cur.rowcount > 0
 
@@ -184,8 +191,8 @@ class SqliteEvents(_Sqlite, base.Events):
         limit: Optional[int] = None,
         reversed_: bool = False,
     ) -> Iterator[Event]:
-        sql = ["SELECT doc FROM events WHERE app_id=? AND channel_id IS ?"]
-        params: list = [app_id, channel_id]
+        sql = ["SELECT doc FROM events WHERE app_id=? AND channel_id=?"]
+        params: list = [app_id, _ck(channel_id)]
         if start_time is not None:
             sql.append("AND event_time_ms >= ?")
             params.append(_to_epoch_ms(start_time))

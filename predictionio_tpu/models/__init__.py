@@ -1,0 +1,7 @@
+"""Built-in engine templates (reference: examples/scala-parallel-* and the
+vendored tests/pio_tests/engines/recommendation-engine).
+
+Each template is a package with the DASE file set of the reference
+templates: engine.py (types + factory), data_source.py, preparator.py,
+<algo>.py, serving.py, evaluation.py, engine.json.
+"""

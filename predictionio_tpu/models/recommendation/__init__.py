@@ -1,0 +1,21 @@
+"""Recommendation template — explicit ALS over rate/buy events.
+
+Parity target: tests/pio_tests/engines/recommendation-engine/ (the engine
+the reference's quickstart integration test drives).
+"""
+
+from predictionio_tpu.models.recommendation.engine import (
+    ActualResult, ItemScore, PredictedResult, Query, RecommendationEngine,
+)
+from predictionio_tpu.models.recommendation.als_algorithm import (
+    ALSAlgorithm, ALSAlgorithmParams, ALSModel,
+)
+from predictionio_tpu.models.recommendation.data_source import (
+    DataSource, DataSourceEvalParams, DataSourceParams, TrainingData,
+)
+
+__all__ = [
+    "ActualResult", "ItemScore", "PredictedResult", "Query",
+    "RecommendationEngine", "ALSAlgorithm", "ALSAlgorithmParams", "ALSModel",
+    "DataSource", "DataSourceEvalParams", "DataSourceParams", "TrainingData",
+]

@@ -1,0 +1,129 @@
+"""ALSAlgorithm: explicit ALS on TPU + device-resident top-K serving.
+
+Parity: recommendation-engine/src/main/scala/ALSAlgorithm.scala
+(params :30-37, train :50-94, predict :95-110, batchPredict :113-148) and
+ALSModel.scala. MLlib `ALS.train` becomes ops.als.train_explicit (or the
+mesh-sharded variant when the WorkflowContext carries a mesh); the factor
+matrices stay in HBM and predict is one fused matmul + top_k.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import Algorithm, Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.recommendation.engine import (
+    ItemScore, PredictedResult, Query,
+)
+from predictionio_tpu.models.recommendation.preparator import PreparedData
+from predictionio_tpu.ops import als, topk
+
+
+@dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    """engine.json keys (rank, numIterations, lambda, seed) — `lambda` is a
+    Python keyword, accepted via the alias (ALSAlgorithm.scala:30-37)."""
+    rank: int = 10
+    numIterations: int = 10
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+    # engine.json uses "lambda"; dataclass fields cannot, so extraction maps it
+    JSON_ALIASES = {"lambda": "lambda_"}
+
+
+@dataclass
+class ALSModel:
+    """Factor matrices + vocabs (ALSModel.scala: MatrixFactorizationModel +
+    the two BiMaps). Arrays may be jax.Array (serving) or numpy (persisted)."""
+    rank: int
+    user_factors: "np.ndarray"   # (n_users, rank)
+    item_factors: "np.ndarray"   # (n_items, rank)
+    user_vocab: BiMap
+    item_vocab: BiMap
+
+    def __str__(self) -> str:
+        return (f"ALSModel(rank={self.rank}, users={len(self.user_vocab)}, "
+                f"items={len(self.item_vocab)})")
+
+
+class ALSAlgorithm(Algorithm):
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: ALSAlgorithmParams):
+        self.ap = params
+        if isinstance(params.seed, dict):  # tolerate {"value": n} Option form
+            raise ValueError("seed must be an integer or null")
+
+    def train(self, ctx, prepared: PreparedData) -> ALSModel:
+        td = prepared.ratings
+        if td.n == 0:
+            raise ValueError(
+                "No ratings found. Please check if DataSource generates "
+                "TrainingData and Preparator generates PreparedData correctly.")
+        # MLlib uses System.nanoTime when no seed given (ALSAlgorithm.scala:56)
+        seed = self.ap.seed if self.ap.seed is not None else (
+            np.random.SeedSequence().entropy % (2 ** 31))
+        data = als.prepare_ratings(
+            td.user_idx, td.item_idx, td.rating,
+            n_users=len(td.user_vocab), n_items=len(td.item_vocab))
+        if ctx is not None and getattr(ctx, "mesh", None) is not None:
+            from predictionio_tpu.parallel import als_dist
+            U, V = als_dist.train_explicit_sharded(
+                ctx.mesh, data, rank=self.ap.rank,
+                iterations=self.ap.numIterations,
+                lambda_=self.ap.lambda_, seed=int(seed))
+            U = U[: len(td.user_vocab)]
+            V = V[: len(td.item_vocab)]
+        else:
+            U, V = als.train_explicit(
+                data, rank=self.ap.rank, iterations=self.ap.numIterations,
+                lambda_=self.ap.lambda_, seed=int(seed))
+        return ALSModel(
+            rank=self.ap.rank, user_factors=U, item_factors=V,
+            user_vocab=td.user_vocab, item_vocab=td.item_vocab)
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        user_ix = model.user_vocab.get(query.user)
+        if user_ix is None:
+            # unknown user -> empty result (ALSAlgorithm.scala:104-108)
+            return PredictedResult(())
+        k = min(query.num, len(model.item_vocab))
+        vals, idx = topk.topk_scores(
+            model.user_factors[user_ix], model.item_factors, k=k)
+        inv = model.item_vocab.inverse()
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        return PredictedResult(tuple(
+            ItemScore(item=inv(int(i)), score=float(s))
+            for s, i in zip(vals, idx)))
+
+    def batch_predict(self, model: ALSModel,
+                      queries: Iterable[Tuple[int, Query]]
+                      ) -> List[Tuple[int, PredictedResult]]:
+        """Eval path: one (b, r) x (r, n_items) matmul + batched top_k for
+        all known users (ALSAlgorithm.scala:113-148 did a cartesian join)."""
+        queries = list(queries)
+        known = [(qx, q, model.user_vocab.get(q.user)) for qx, q in queries]
+        out: List[Tuple[int, PredictedResult]] = [
+            (qx, PredictedResult(())) for qx, _q, ix in known if ix is None]
+        valid = [(qx, q, ix) for qx, q, ix in known if ix is not None]
+        if not valid:
+            return out
+        max_num = max(q.num for _qx, q, _ix in valid)
+        k = min(max_num, len(model.item_vocab))
+        U = np.asarray(model.user_factors)
+        ixs = np.asarray([ix for _qx, _q, ix in valid], dtype=np.int32)
+        vals, idx = topk.topk_scores_batch(U[ixs], model.item_factors, k=k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        inv = model.item_vocab.inverse()
+        for row, (qx, q, _ix) in enumerate(valid):
+            n = min(q.num, k)
+            out.append((qx, PredictedResult(tuple(
+                ItemScore(item=inv(int(i)), score=float(s))
+                for s, i in zip(vals[row, :n], idx[row, :n])))))
+        return out

@@ -1,0 +1,140 @@
+"""DataSource: rate/buy events -> columnar ratings + k-fold eval splits.
+
+Parity: recommendation-engine/src/main/scala/DataSource.scala
+(getRatings :46-74, readTraining :76-80, readEval :82-107). The RDD
+map/filter chains become one columnar pass (store.find_columnar) producing
+vocab-encoded numpy arrays headed for the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import DataSource as BaseDataSource
+from predictionio_tpu.controller import EmptyEvaluationInfo, Params, SanityCheck
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.recommendation.engine import (
+    ActualResult, Query, Rating,
+)
+
+#: buy events carry no rating property; the template maps them to 4.0
+#: (DataSource.scala:57-59)
+BUY_RATING = 4.0
+
+
+@dataclass(frozen=True)
+class DataSourceEvalParams(Params):
+    kFold: int
+    queryNum: int
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str
+    evalParams: Optional[dict] = None  # {"kFold": int, "queryNum": int}
+
+    def eval_params(self) -> Optional[DataSourceEvalParams]:
+        if self.evalParams is None:
+            return None
+        if isinstance(self.evalParams, DataSourceEvalParams):
+            return self.evalParams
+        return DataSourceEvalParams(**self.evalParams)
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    """Columnar, vocab-encoded ratings (the RDD[Rating] analogue)."""
+    user_idx: np.ndarray     # (n,) int32
+    item_idx: np.ndarray     # (n,) int32
+    rating: np.ndarray       # (n,) float32
+    user_vocab: BiMap
+    item_vocab: BiMap
+
+    @property
+    def n(self) -> int:
+        return int(self.user_idx.shape[0])
+
+    def sanity_check(self) -> None:
+        if self.n == 0:
+            raise ValueError(
+                "ratings is empty — is your event store populated and "
+                "appName correct?")
+
+    def __str__(self) -> str:
+        return (f"ratings: [{self.n}] "
+                f"({self.n and list(zip(self.user_idx[:2], self.item_idx[:2], self.rating[:2]))}...)")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.dsp = params
+
+    def _get_ratings(self, ctx,
+                     entity_vocab=None, target_vocab=None) -> TrainingData:
+        col = store.find_columnar(
+            self.dsp.appName,
+            entity_type="user",
+            event_names=["rate", "buy"],
+            target_entity_type="item",
+            rating_property="rating",
+            entity_vocab=entity_vocab,
+            target_vocab=target_vocab,
+            storage=ctx.storage,
+        )
+        rating = col.rating.copy()
+        # buy -> 4.0 regardless of properties (DataSource.scala:57-59)
+        if "buy" in col.event_names:
+            buy_code = col.event_names.index("buy")
+            rating[col.event_name_idx == buy_code] = BUY_RATING
+        if np.isnan(rating).any():
+            bad = int(np.isnan(rating).sum())
+            raise ValueError(
+                f"{bad} rate event(s) have no numeric 'rating' property — "
+                "cannot convert to Rating (DataSource.scala:62-68 behavior)")
+        return TrainingData(
+            user_idx=col.entity_idx, item_idx=col.target_idx, rating=rating,
+            user_vocab=col.entity_ids, item_vocab=col.target_ids,
+        )
+
+    def read_training(self, ctx) -> TrainingData:
+        return self._get_ratings(ctx)
+
+    def read_eval(self, ctx):
+        """k-fold split by rating index % k (readEval, DataSource.scala:82-107):
+        per fold, test-fold ratings grouped by user become
+        (Query(user, queryNum), ActualResult(user's test ratings))."""
+        ep = self.dsp.eval_params()
+        if ep is None:
+            raise ValueError("Must specify evalParams")
+        td = self._get_ratings(ctx)
+        k = ep.kFold
+        idx = np.arange(td.n)
+        inv_user = td.user_vocab.inverse()
+        inv_item = td.item_vocab.inverse()
+        folds = []
+        for fold in range(k):
+            test_mask = (idx % k) == fold
+            train = TrainingData(
+                user_idx=td.user_idx[~test_mask],
+                item_idx=td.item_idx[~test_mask],
+                rating=td.rating[~test_mask],
+                user_vocab=td.user_vocab, item_vocab=td.item_vocab,
+            )
+            qa: List[Tuple[Query, ActualResult]] = []
+            by_user: Dict[int, List[Rating]] = {}
+            for u, i, r in zip(td.user_idx[test_mask],
+                               td.item_idx[test_mask],
+                               td.rating[test_mask]):
+                by_user.setdefault(int(u), []).append(
+                    Rating(inv_user(int(u)), inv_item(int(i)), float(r)))
+            for u, ratings in by_user.items():
+                qa.append((Query(user=inv_user(int(u)), num=ep.queryNum),
+                           ActualResult(tuple(ratings))))
+            folds.append((train, EmptyEvaluationInfo(), qa))
+        return folds

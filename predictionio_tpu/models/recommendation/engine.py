@@ -1,0 +1,56 @@
+"""Query/result types + engine factory.
+
+Parity: recommendation-engine/src/main/scala/Engine.scala (Query,
+PredictedResult, ActualResult, ItemScore, RecommendationEngine factory).
+Field names are camelCase to keep the serving JSON contract byte-compatible
+with the reference ({"user": ..., "num": ...} -> {"itemScores": [...]}).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: Tuple[ItemScore, ...] = ()
+
+
+@dataclass(frozen=True)
+class Rating:
+    user: str
+    item: str
+    rating: float
+
+
+@dataclass(frozen=True)
+class ActualResult:
+    ratings: Tuple[Rating, ...] = ()
+
+
+def RecommendationEngine():
+    """Engine factory (Engine.scala:41-48)."""
+    from predictionio_tpu.controller import Engine, FirstServing
+    from predictionio_tpu.models.recommendation.als_algorithm import ALSAlgorithm
+    from predictionio_tpu.models.recommendation.data_source import DataSource
+    from predictionio_tpu.models.recommendation.preparator import Preparator
+
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"als": ALSAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -129,8 +129,15 @@ def gram_rhs(
     fall into segment n_self and are sliced off.
     """
     nnz_pad = self_idx.shape[0]
-    n_chunks = max(nnz_pad // chunk, 1)
-    chunk = nnz_pad // n_chunks
+    n_chunks = max(-(-nnz_pad // chunk), 1)
+    target = n_chunks * chunk
+    if target != nnz_pad:
+        # shapes are static, so this pad compiles away into the layout
+        extra = target - nnz_pad
+        self_idx = jnp.pad(self_idx, (0, extra), constant_values=n_self)
+        other_idx = jnp.pad(other_idx, (0, extra))
+        coeff_a = jnp.pad(coeff_a, (0, extra))
+        coeff_b = jnp.pad(coeff_b, (0, extra))
     r = other_factors.shape[1]
 
     si = self_idx.reshape(n_chunks, chunk)
@@ -307,8 +314,15 @@ def train_implicit(
 def rmse(U, V, user_idx, item_idx, rating, mask, chunk: int = 1 << 18):
     """Root-mean-square error over observed (possibly padded) entries."""
     nnz_pad = user_idx.shape[0]
-    n_chunks = max(nnz_pad // chunk, 1)
-    c = nnz_pad // n_chunks
+    n_chunks = max(-(-nnz_pad // chunk), 1)
+    target = n_chunks * chunk if n_chunks * chunk >= nnz_pad else nnz_pad
+    if target != nnz_pad:
+        extra = target - nnz_pad
+        user_idx = jnp.pad(user_idx, (0, extra))
+        item_idx = jnp.pad(item_idx, (0, extra))
+        rating = jnp.pad(rating, (0, extra))
+        mask = jnp.pad(mask, (0, extra))
+    c = target // n_chunks
 
     def body(carry, xs):
         se, n = carry

@@ -1,0 +1,15 @@
+"""Workflow runtime: train/eval/deploy drivers + run ledger.
+
+Reference: core/.../workflow/ (CreateWorkflow.scala, CoreWorkflow.scala,
+EvaluationWorkflow.scala, CreateServer.scala, WorkflowUtils.scala).
+
+The reference spawns a spark-submit JVM per run; here a run is an in-process
+call (or a subprocess for daemon deploys) in a single-controller JAX
+process. The EngineInstance/EvaluationInstance ledger semantics are kept
+exactly: INIT -> COMPLETED / EVALCOMPLETED rows gate deploys.
+"""
+
+from predictionio_tpu.workflow.context import WorkflowContext, WorkflowParams
+from predictionio_tpu.workflow.core_workflow import run_evaluation, run_train
+
+__all__ = ["WorkflowContext", "WorkflowParams", "run_train", "run_evaluation"]

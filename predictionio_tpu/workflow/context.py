@@ -1,0 +1,55 @@
+"""WorkflowContext — the SparkContext analogue.
+
+Reference: core/.../workflow/WorkflowContext.scala:28-50 (context factory)
+and WorkflowParams (core/.../workflow/WorkflowParams.scala).
+
+One context per run. It owns:
+- the device mesh (None = single-device; tests/dry-runs pass a CPU mesh);
+- the WorkflowParams (batch label, sanity-check / stop-after flags);
+- the Storage handle engines read events through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from predictionio_tpu.data.storage import Storage, get_storage
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    """Mirror of WorkflowParams.scala (batch, verbose, skipSanityCheck,
+    stopAfterRead, stopAfterPrepare)."""
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+class WorkflowContext:
+    def __init__(
+        self,
+        workflow_params: Optional[WorkflowParams] = None,
+        mesh=None,
+        storage: Optional[Storage] = None,
+        runtime_env: Optional[Dict[str, str]] = None,
+        app_name: str = "",
+    ):
+        self.workflow_params = workflow_params or WorkflowParams()
+        self.mesh = mesh
+        self._storage = storage
+        self.runtime_env = dict(runtime_env or {})
+        # appName analogue: "PredictionIO <mode>: <batch>" (WorkflowContext.scala:36-38)
+        self.app_name = app_name
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage if self._storage is not None else get_storage()
+
+    @property
+    def n_devices(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.devices.size)

@@ -1,0 +1,121 @@
+"""Training/evaluation run bookkeeping around the engine.
+
+Reference: core/.../workflow/CoreWorkflow.scala:45-160 and
+EvaluationWorkflow.scala:32-45. A train run: insert EngineInstance(INIT),
+engine.train, serialize models into the Models store keyed by the instance
+id, mark COMPLETED. An eval run: insert EvaluationInstance, batch-eval every
+EngineParams variant (prefix-memoized, FastEvalEngine parity), score with
+the MetricEvaluator, store results, mark EVALCOMPLETED.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import traceback
+from typing import Optional, Sequence
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.evaluation import (
+    Evaluation, MetricEvaluatorResult,
+)
+from predictionio_tpu.data.storage import (
+    EngineInstance, EvaluationInstance, Model,
+)
+from predictionio_tpu.workflow import model_io
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.fast_eval import FastEvalEngineWorkflow
+
+logger = logging.getLogger("predictionio_tpu.workflow")
+
+
+def _now():
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def run_train(
+    ctx: WorkflowContext,
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_id: str = "default",
+    engine_version: str = "NOT_USED",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    params_json: Optional[dict] = None,
+) -> str:
+    """Run one training; returns the COMPLETED EngineInstance id
+    (CoreWorkflow.runTrain, CoreWorkflow.scala:45-101)."""
+    storage = ctx.storage
+    instances = storage.get_meta_data_engine_instances()
+    import json as _json
+    pj = params_json or {}
+    instance = EngineInstance(
+        id="", status="INIT", start_time=_now(), end_time=_now(),
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant, engine_factory=engine_factory,
+        batch=ctx.workflow_params.batch, env=dict(ctx.runtime_env),
+        data_source_params=_json.dumps(pj.get("datasource", {})),
+        preparator_params=_json.dumps(pj.get("preparator", {})),
+        algorithms_params=_json.dumps(pj.get("algorithms", [])),
+        serving_params=_json.dumps(pj.get("serving", {})),
+    )
+    instance_id = instances.insert(instance)
+    logger.info("EngineInstance %s created (INIT)", instance_id)
+    try:
+        models = engine.train(ctx, engine_params)
+        blob = model_io.serialize_models(models)
+        storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
+        row = instances.get(instance_id)
+        instances.update(EngineInstance(
+            **{**row.__dict__, "status": "COMPLETED", "end_time": _now()}))
+        logger.info("Training completed; EngineInstance %s COMPLETED "
+                    "(model blob %d bytes)", instance_id, len(blob))
+        return instance_id
+    except Exception:
+        row = instances.get(instance_id)
+        if row is not None:
+            instances.update(EngineInstance(
+                **{**row.__dict__, "status": "ERROR", "end_time": _now()}))
+        logger.error("Training failed:\n%s", traceback.format_exc())
+        raise
+
+
+def run_evaluation(
+    ctx: WorkflowContext,
+    evaluation: Evaluation,
+    engine_params_list: Sequence[EngineParams],
+    evaluation_class: str = "",
+    generator_class: str = "",
+    output_path: Optional[str] = None,
+) -> MetricEvaluatorResult:
+    """Evaluate every variant, pick the best, persist the ledger row
+    (CoreWorkflow.runEvaluation :103-160 + EvaluationWorkflow.scala:32-45)."""
+    storage = ctx.storage
+    instances = storage.get_meta_data_evaluation_instances()
+    instance_id = instances.insert(EvaluationInstance(
+        id="", status="INIT", start_time=_now(), end_time=_now(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=generator_class,
+        batch=ctx.workflow_params.batch, env=dict(ctx.runtime_env)))
+    try:
+        workflow = FastEvalEngineWorkflow(evaluation.engine, ctx)
+        engine_eval_data_sets = [
+            (ep, workflow.eval(ep)) for ep in engine_params_list]
+        evaluator = evaluation.evaluator
+        if output_path:
+            evaluator.output_path = output_path
+        result = evaluator.evaluate_base(ctx, evaluation, engine_eval_data_sets)
+        row = instances.get(instance_id)
+        instances.update(EvaluationInstance(
+            **{**row.__dict__, "status": "EVALCOMPLETED", "end_time": _now(),
+               "evaluator_results": str(result),
+               "evaluator_results_html": result.to_html(),
+               "evaluator_results_json": result.to_json()}))
+        logger.info("EvaluationInstance %s EVALCOMPLETED", instance_id)
+        return result
+    except Exception:
+        row = instances.get(instance_id)
+        if row is not None:
+            instances.update(EvaluationInstance(
+                **{**row.__dict__, "status": "ERROR", "end_time": _now()}))
+        raise

@@ -1,0 +1,110 @@
+"""Prefix-memoized evaluation across EngineParams variants.
+
+Reference: core/.../controller/FastEvalEngine.scala:46-345. When an
+evaluation grid shares leading params (same data-source params across all
+rank values, say), re-running the shared prefix is pure waste. The reference
+memoizes per-prefix RDD pipelines keyed by `*Prefix` case classes; here the
+caches are dicts keyed by the canonical JSON of the prefix params:
+
+  data-source prefix  -> eval folds [(TD, EI, [(Q, A)])]
+  preparator prefix   -> prepared data per fold
+  algorithms prefix   -> trained models per fold
+  serving prefix      -> full (EI, [(Q, P, A)]) eval output
+
+A 3x3 hyper-grid over one data source reads data once, prepares once, and
+trains 9 times instead of 9/9/9 — the same win FastEvalEngineTest asserts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Tuple
+
+from predictionio_tpu.controller.base import create_doer
+from predictionio_tpu.controller.engine import Engine, EngineParams
+
+
+def _key(*params) -> str:
+    def enc(p):
+        if isinstance(p, tuple) and len(p) == 2 and isinstance(p[0], str):
+            return [p[0], enc(p[1])]
+        if dataclasses.is_dataclass(p):
+            return {type(p).__name__: dataclasses.asdict(p)}
+        if isinstance(p, (list, tuple)):
+            return [enc(x) for x in p]
+        return repr(p)
+    return json.dumps([enc(p) for p in params], sort_keys=True, default=repr)
+
+
+class FastEvalEngineWorkflow:
+    """Holds the prefix caches for one evaluation run."""
+
+    def __init__(self, engine: Engine, ctx):
+        self.engine = engine
+        self.ctx = ctx
+        self.data_source_cache: Dict[str, Any] = {}
+        self.preparator_cache: Dict[str, Any] = {}
+        self.algorithms_cache: Dict[str, Any] = {}
+        self.serving_cache: Dict[str, Any] = {}
+        # instrumentation (FastEvalEngineTest parity: assert build counts)
+        self.counts = {"read_eval": 0, "prepare": 0, "train": 0, "serve": 0}
+
+    def _eval_folds(self, ds_params):
+        k = _key(ds_params)
+        if k not in self.data_source_cache:
+            ds = create_doer(self.engine.data_source_class, ds_params)
+            self.data_source_cache[k] = ds.read_eval(self.ctx)
+            self.counts["read_eval"] += 1
+        return self.data_source_cache[k]
+
+    def _prepared(self, ds_params, prep_params):
+        k = _key(ds_params, prep_params)
+        if k not in self.preparator_cache:
+            folds = self._eval_folds(ds_params)
+            prep = create_doer(self.engine.preparator_class, prep_params)
+            self.preparator_cache[k] = [
+                prep.prepare(self.ctx, td) for td, _ei, _qa in folds]
+            self.counts["prepare"] += 1
+        return self.preparator_cache[k]
+
+    def _models(self, ds_params, prep_params, algo_params_list):
+        k = _key(ds_params, prep_params, algo_params_list)
+        if k not in self.algorithms_cache:
+            prepared = self._prepared(ds_params, prep_params)
+            algos = [
+                create_doer(self.engine.algorithm_class_map[name], ap)
+                for name, ap in algo_params_list]
+            self.algorithms_cache[k] = [
+                [a.train(self.ctx, pd) for a in algos] for pd in prepared]
+            self.counts["train"] += 1
+        return self.algorithms_cache[k]
+
+    def eval(self, engine_params: EngineParams
+             ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        ds_p = engine_params.data_source_params
+        pr_p = engine_params.preparator_params
+        al_p = tuple(engine_params.algorithm_params_list)
+        sv_p = engine_params.serving_params
+        k = _key(ds_p, pr_p, al_p, sv_p)
+        if k not in self.serving_cache:
+            folds = self._eval_folds(ds_p)
+            models_per_fold = self._models(ds_p, pr_p, al_p)
+            algos = [
+                create_doer(self.engine.algorithm_class_map[name], ap)
+                for name, ap in al_p]
+            serving = create_doer(self.engine.serving_class, sv_p)
+            out = []
+            for (td, ei, qa_list), models in zip(folds, models_per_fold):
+                indexed_q = [(qx, serving.supplement(q))
+                             for qx, (q, _a) in enumerate(qa_list)]
+                per_algo = [
+                    dict(algo.batch_predict(model, indexed_q))
+                    for algo, model in zip(algos, models)]
+                qpa = [
+                    (q, serving.serve(q, [pred[qx] for pred in per_algo]), a)
+                    for qx, (q, a) in enumerate(qa_list)]
+                out.append((ei, qpa))
+            self.serving_cache[k] = out
+            self.counts["serve"] += 1
+        return self.serving_cache[k]

@@ -1,0 +1,73 @@
+"""Model (de)serialization for the Models store.
+
+Reference role: the Kryo blob path (CoreWorkflow.scala:76-81 serialize;
+CreateServer.scala:195-199 deserialize). Here the container is pickle with
+every jax.Array converted to numpy on save and restored host-side on load;
+`device_put_tree` pushes a loaded model's arrays back into HBM at deploy
+(the "factor matrices straight into HBM" path of BASELINE.json).
+
+Models are arbitrary user objects (dataclasses, dicts, tuples, BiMaps...),
+not registered pytrees, so the walker is structural rather than
+jax.tree_util-based.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any, Callable, List
+
+import jax
+import numpy as np
+
+
+def _map_arrays(obj: Any, leaf_p: Callable[[Any], bool],
+                fn: Callable[[Any], Any]) -> Any:
+    if leaf_p(obj):
+        return fn(obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        changes = {
+            f.name: _map_arrays(getattr(obj, f.name), leaf_p, fn)
+            for f in dataclasses.fields(obj)}
+        try:
+            return dataclasses.replace(obj, **changes)
+        except (TypeError, ValueError):
+            # non-init fields etc.: mutate a shallow copy
+            import copy
+            new = copy.copy(obj)
+            for k, v in changes.items():
+                object.__setattr__(new, k, v)
+            return new
+    if isinstance(obj, dict):
+        return {k: _map_arrays(v, leaf_p, fn) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_map_arrays(x, leaf_p, fn) for x in obj)
+    if isinstance(obj, list):
+        return [_map_arrays(x, leaf_p, fn) for x in obj]
+    return obj
+
+
+def to_host(obj: Any) -> Any:
+    """jax.Array leaves -> numpy (blocking transfer)."""
+    return _map_arrays(obj, lambda x: isinstance(x, jax.Array),
+                       lambda x: np.asarray(x))
+
+
+def serialize_models(models: List[Any]) -> bytes:
+    return pickle.dumps(to_host(models), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_models(blob: bytes) -> List[Any]:
+    return pickle.loads(blob)
+
+
+def device_put_tree(obj: Any, sharding=None) -> Any:
+    """Push every numeric numpy leaf of a model tree into device memory
+    (optionally with a NamedSharding for multi-chip serving)."""
+    def put(x):
+        return (jax.device_put(x, sharding) if sharding is not None
+                else jax.device_put(x))
+    return _map_arrays(
+        obj,
+        lambda x: isinstance(x, np.ndarray) and x.dtype != object,
+        put)

@@ -1,0 +1,108 @@
+"""Engine/evaluation loading + engine.json parsing.
+
+Reference: core/.../workflow/WorkflowUtils.scala:53-121 (reflective
+getEngine/getEvaluation/getEngineParamsGenerator) and the engine variant
+JSON contract (Engine.scala:357-420). JVM reflection becomes Python import
+paths: "package.module:attr" where attr is an Engine instance, a zero-arg
+factory returning one, or an Evaluation/EngineParamsGenerator subclass.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.controller.evaluation import Evaluation, EngineParamsGenerator
+
+
+def load_object(path: str, base_dir: Optional[str] = None) -> Any:
+    """Resolve "module.sub:attr" (or "module.sub.attr") to a Python object.
+
+    `base_dir` (the engine directory, analogue of the engine assembly jar on
+    the spark-submit classpath) is prepended to sys.path so engine templates
+    load from their own directory.
+    """
+    if base_dir and base_dir not in sys.path:
+        sys.path.insert(0, os.path.abspath(base_dir))
+    if ":" in path:
+        module_name, attr = path.split(":", 1)
+    else:
+        module_name, _, attr = path.rpartition(".")
+        if not module_name:
+            raise ValueError(
+                f"cannot resolve {path!r}: expected 'module:attr' or "
+                "'module.attr'")
+    module = importlib.import_module(module_name)
+    obj = module
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def get_engine(engine_factory: str, base_dir: Optional[str] = None) -> Engine:
+    """EngineFactory resolution (WorkflowUtils.getEngine, scala object vs
+    class detection :53-87 → instance vs callable detection here)."""
+    obj = load_object(engine_factory, base_dir)
+    if isinstance(obj, Engine):
+        return obj
+    if callable(obj):
+        engine = obj()
+        if isinstance(engine, Engine):
+            return engine
+    raise TypeError(
+        f"{engine_factory!r} is neither an Engine nor a factory returning one")
+
+
+def get_evaluation(path: str, base_dir: Optional[str] = None) -> Evaluation:
+    obj = load_object(path, base_dir)
+    if isinstance(obj, Evaluation):
+        return obj
+    if isinstance(obj, type) and issubclass(obj, Evaluation):
+        return obj()
+    raise TypeError(f"{path!r} is not an Evaluation")
+
+
+def get_engine_params_generator(
+        path: str, base_dir: Optional[str] = None) -> EngineParamsGenerator:
+    obj = load_object(path, base_dir)
+    if isinstance(obj, EngineParamsGenerator):
+        return obj
+    if isinstance(obj, type) and issubclass(obj, EngineParamsGenerator):
+        return obj()
+    raise TypeError(f"{path!r} is not an EngineParamsGenerator")
+
+
+def read_engine_variant(engine_dir: str,
+                        variant: str = "engine.json") -> Dict[str, Any]:
+    """Load + minimally validate an engine variant file."""
+    path = variant if os.path.isabs(variant) else os.path.join(engine_dir, variant)
+    with open(path) as f:
+        variant_json = json.load(f)
+    for field in ("id", "engineFactory"):
+        if field not in variant_json:
+            raise ValueError(f"{path}: missing required field {field!r}")
+    return variant_json
+
+
+def runtime_conf_from_variant(variant_json: Dict[str, Any]) -> Dict[str, str]:
+    """Flatten the optional `runtimeConf`/`sparkConf` subtree into dotted
+    key/value pairs (WorkflowUtils.extractSparkConf, WorkflowUtils.scala:
+    317-351 — kept for config-surface parity; TPU runs use it for XLA/mesh
+    settings)."""
+    sub = variant_json.get("runtimeConf", variant_json.get("sparkConf", {}))
+    out: Dict[str, str] = {}
+
+    def walk(prefix: str, node: Any):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            out[prefix] = str(node)
+
+    walk("", sub)
+    return out

@@ -1,0 +1,176 @@
+"""DASE engine + workflow tests (parity with the reference's
+EngineWorkflowTest/EngineTest fixtures plus the recommendation template)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm, DataSource, EngineParams, Engine, FirstServing, Params,
+    Preparator, Serving,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, Query, RecommendationEngine,
+)
+from predictionio_tpu.workflow import (
+    WorkflowContext, WorkflowParams, run_train,
+)
+from predictionio_tpu.workflow import model_io
+from predictionio_tpu.workflow.workflow_utils import (
+    get_engine, read_engine_variant,
+)
+
+
+@pytest.fixture()
+def rated_app(memory_storage):
+    """An app with deterministic rate/buy events: users u0..u9, items i0..i7."""
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "MyApp1", None))
+    memory_storage.get_events().init(app_id)
+    import datetime as dt
+    events = []
+    minute = 0
+    for u in range(10):
+        for i in range(8):
+            if (u + i) % 3 == 0:
+                continue  # hold some pairs out
+            minute += 1
+            # users like items with matching parity (structured signal)
+            r = 5.0 if (u % 2) == (i % 2) else 1.0
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r}),
+                event_time=dt.datetime(2021, 1, 1, 0, minute % 60,
+                                       tzinfo=dt.timezone.utc)))
+    # a couple of buy events (implicit 4.0)
+    events.append(Event(
+        event="buy", entity_type="user", entity_id="u0",
+        target_entity_type="item", target_entity_id="i0",
+        event_time=dt.datetime(2021, 1, 1, 1, tzinfo=dt.timezone.utc)))
+    store.write(events, app_id, storage=memory_storage)
+    return app_id
+
+
+def engine_params(app_name="MyApp1", rank=4, iters=8, eval_params=None):
+    return EngineParams(
+        data_source_params=DataSourceParams(appName=app_name,
+                                            evalParams=eval_params),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=rank, numIterations=iters,
+                                       lambda_=0.05, seed=3)),))
+
+
+def test_engine_json_extraction():
+    engine = RecommendationEngine()
+    variant = json.loads("""
+    {"id": "default", "engineFactory": "x",
+     "datasource": {"params": {"appName": "MyApp1"}},
+     "algorithms": [{"name": "als",
+        "params": {"rank": 10, "numIterations": 10, "lambda": 0.01, "seed": 3}}]}
+    """)
+    ep = engine.engine_params_from_json(variant)
+    assert ep.data_source_params.appName == "MyApp1"
+    name, ap = ep.algorithm_params_list[0]
+    assert name == "als" and ap.rank == 10 and ap.lambda_ == 0.01 and ap.seed == 3
+
+
+def test_engine_json_unknown_param_rejected():
+    engine = RecommendationEngine()
+    variant = {"id": "x", "engineFactory": "x",
+               "datasource": {"params": {"appName": "a", "bogus": 1}},
+               "algorithms": [{"name": "als", "params": {}}]}
+    with pytest.raises(ValueError, match="bogus"):
+        engine.engine_params_from_json(variant)
+
+
+def test_engine_json_unknown_algorithm_rejected():
+    engine = RecommendationEngine()
+    variant = {"id": "x", "engineFactory": "x",
+               "datasource": {"params": {"appName": "a"}},
+               "algorithms": [{"name": "nope", "params": {}}]}
+    with pytest.raises(KeyError, match="nope"):
+        engine.engine_params_from_json(variant)
+
+
+def test_train_and_predict(memory_storage, rated_app):
+    engine = RecommendationEngine()
+    ctx = WorkflowContext(storage=memory_storage)
+    models = engine.train(ctx, engine_params())
+    assert len(models) == 1
+    model = models[0]
+    algo = engine.algorithm_class_map["als"](
+        ALSAlgorithmParams(rank=4, numIterations=8, seed=3))
+    result = algo.predict(model, Query(user="u0", num=4))
+    assert len(result.itemScores) == 4
+    items = [s.item for s in result.itemScores]
+    assert len(set(items)) == 4
+    # structured signal: u0 (even) should rank an even item first
+    assert int(result.itemScores[0].item[1:]) % 2 == 0
+    # unknown user -> empty result, no crash (ALSAlgorithm.scala:104-108)
+    empty = algo.predict(model, Query(user="ghost", num=4))
+    assert empty.itemScores == ()
+
+
+def test_run_train_ledger_and_model_roundtrip(memory_storage, rated_app):
+    engine = RecommendationEngine()
+    ctx = WorkflowContext(storage=memory_storage)
+    instance_id = run_train(
+        ctx, engine, engine_params(), engine_variant="default",
+        engine_factory="predictionio_tpu.models.recommendation.engine:RecommendationEngine")
+    row = memory_storage.get_meta_data_engine_instances().get(instance_id)
+    assert row.status == "COMPLETED"
+    blob = memory_storage.get_model_data_models().get(instance_id)
+    assert blob is not None
+    models = model_io.deserialize_models(blob.models)
+    model = models[0]
+    assert isinstance(model.user_factors, np.ndarray)  # host arrays persisted
+    # deploy-side: arrays go back to device and serve
+    model = model_io.device_put_tree(model)
+    algo = engine.algorithm_class_map["als"](ALSAlgorithmParams())
+    result = algo.predict(model, Query(user="u1", num=3))
+    assert len(result.itemScores) == 3
+
+
+def test_run_train_failure_marks_error(memory_storage):
+    # no app in storage -> DataSource raises -> instance must be ERROR
+    engine = RecommendationEngine()
+    ctx = WorkflowContext(storage=memory_storage)
+    with pytest.raises(Exception):
+        run_train(ctx, engine, engine_params(app_name="missing"))
+    rows = memory_storage.get_meta_data_engine_instances().get_all()
+    assert len(rows) == 1 and rows[0].status == "ERROR"
+
+
+def test_stop_after_read_flag(memory_storage, rated_app):
+    from predictionio_tpu.controller.engine import StopAfterReadInterruption
+    engine = RecommendationEngine()
+    ctx = WorkflowContext(
+        workflow_params=WorkflowParams(stop_after_read=True),
+        storage=memory_storage)
+    with pytest.raises(StopAfterReadInterruption):
+        engine.train(ctx, engine_params())
+
+
+def test_sanity_check_empty_ratings(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    apps.insert(App(0, "EmptyApp", None))
+    engine = RecommendationEngine()
+    ctx = WorkflowContext(storage=memory_storage)
+    with pytest.raises(ValueError, match="empty"):
+        engine.train(ctx, engine_params(app_name="EmptyApp"))
+
+
+def test_engine_factory_loading():
+    engine = get_engine(
+        "predictionio_tpu.models.recommendation.engine:RecommendationEngine")
+    assert isinstance(engine, Engine)
+    variant = read_engine_variant(
+        "predictionio_tpu/models/recommendation", "engine.json")
+    ep = engine.engine_params_from_json(variant)
+    assert ep.algorithm_params_list[0][1].rank == 10
