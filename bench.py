@@ -1,0 +1,98 @@
+"""Headline benchmark: ALS training on MovieLens-20M-scale data.
+
+The reference's north-star workload (BASELINE.json): `pio train` on the
+Recommendation template — MLlib ALS, rank=10, 10 iterations, lambda=0.01
+(tests/pio_tests/engines/recommendation-engine/engine.json:14-17). The
+reference publishes no numbers (SURVEY.md §6), so `vs_baseline` is reported
+against a Spark-local reference estimate only when BASELINE.json carries a
+published figure; otherwise null.
+
+Data is synthetic at ML-20M scale (138k users x 27k items x 20M ratings;
+zero-egress environment, so the real dataset cannot be downloaded) with a
+power-law user-activity profile so per-user nnz skew resembles the real
+thing. Prints ONE JSON line.
+
+Env knobs: BENCH_NNZ / BENCH_USERS / BENCH_ITEMS / BENCH_ITERS override the
+workload size (used for smoke-testing on CPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def synth_ratings(n_users: int, n_items: int, nnz: int, seed: int = 3):
+    rng = np.random.default_rng(seed)
+    # Zipf-ish popularity for items, log-normal activity for users.
+    user_w = rng.lognormal(0.0, 1.2, n_users)
+    item_w = 1.0 / np.arange(1, n_items + 1) ** 0.8
+    u = rng.choice(n_users, size=nnz, p=user_w / user_w.sum()).astype(np.int32)
+    i = rng.choice(n_items, size=nnz, p=item_w / item_w.sum()).astype(np.int32)
+    r = np.clip(rng.normal(3.5, 1.1, nnz), 0.5, 5.0).astype(np.float32)
+    return u, i, r
+
+
+def main() -> None:
+    import jax
+
+    from predictionio_tpu.ops import als, topk
+
+    n_users = int(os.environ.get("BENCH_USERS", 138_000))
+    n_items = int(os.environ.get("BENCH_ITEMS", 27_000))
+    nnz = int(os.environ.get("BENCH_NNZ", 20_000_000))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+
+    u, i, r = synth_ratings(n_users, n_items, nnz)
+    data = als.prepare_ratings(u, i, r, n_users=n_users, n_items=n_items)
+
+    # Warm-up: compile the full training program once (cached thereafter).
+    warm = als.prepare_ratings(u[:1024], i[:1024], r[:1024],
+                               n_users=n_users, n_items=n_items)
+    als.train_explicit(warm, rank=10, iterations=1, lambda_=0.01, seed=3)
+
+    t0 = time.perf_counter()
+    U, V = als.train_explicit(data, rank=10, iterations=iters,
+                              lambda_=0.01, seed=3)
+    jax.block_until_ready((U, V))
+    train_s = time.perf_counter() - t0
+
+    # Serving path: p50 of single-user top-10 from device-resident factors.
+    import jax.numpy as jnp
+    Ud, Vd = jnp.asarray(U), jnp.asarray(V)
+    lat = []
+    for q in range(50):
+        t0 = time.perf_counter()
+        vals, idx = topk.topk_scores(Ud[q % n_users], Vd, k=10)
+        jax.block_until_ready((vals, idx))
+        lat.append(time.perf_counter() - t0)
+    p50_ms = float(np.median(lat) * 1e3)
+
+    published = {}
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            published = json.load(f).get("published", {}) or {}
+    except Exception:
+        pass
+    base = published.get("als_train_ml20m_s")
+    vs = (base / train_s) if base else None
+
+    print(json.dumps({
+        "metric": "als_ml20m_train_wallclock",
+        "value": round(train_s, 3),
+        "unit": "s",
+        "vs_baseline": vs,
+        "detail": {
+            "nnz": nnz, "rank": 10, "iterations": iters,
+            "throughput_ratings_per_s": round(nnz * iters / train_s),
+            "predict_p50_ms": round(p50_ms, 3),
+            "device": str(jax.devices()[0]).split(":")[0],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

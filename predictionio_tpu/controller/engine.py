@@ -150,7 +150,9 @@ class Engine:
         eval_sets = data_source.read_eval(ctx)
         out = []
         for td, ei, qa_list in eval_sets:
+            self._sanity_check(td, params)
             pd = preparator.prepare(ctx, td)
+            self._sanity_check(pd, params)
             models = [a.train(ctx, pd) for a in algorithms]
             indexed_q = [(qx, serving.supplement(q))
                          for qx, (q, _a) in enumerate(qa_list)]
@@ -163,7 +165,6 @@ class Engine:
                 ps = [pred[qx] for pred in per_algo]
                 qpa.append((q, serving.serve(q, ps), a))
             out.append((ei, qpa))
-        del params
         return out
 
     # -- engine.json extraction (Engine.scala:357-420) -----------------------
@@ -175,6 +176,13 @@ class Engine:
             getattr(self.preparator_class, "params_class", None),
             (variant_json.get("preparator") or {}).get("params", {}))
         algo_list = []
+        if "algorithms" not in variant_json and "" in self.algorithm_class_map:
+            # Missing section defaults to the SimpleEngine algorithm under
+            # its registered "" key (Engine.scala:402 falls back to
+            # Seq(("", EmptyParams()))).
+            algo_list.append(("", _params_from_json(
+                getattr(self.algorithm_class_map[""], "params_class", None),
+                {})))
         for entry in variant_json.get("algorithms", []):
             name = entry.get("name")
             if name is None:

@@ -142,9 +142,16 @@ class MetricEvaluator:
             logger.info("Iteration score: %s (others: %s)", score, others)
             scores.append(MetricScores(ep, score, others))
 
-        best_idx, best = max(
-            enumerate(scores),
-            key=lambda kv: self.metric.comparison_sign * kv[1].score)
+        def _order_key(kv):
+            # NaN compares False against everything, which would let a
+            # NaN-scoring variant 0 win by default; rank NaN below any
+            # finite score instead.
+            s = kv[1].score
+            if s != s:
+                return float("-inf")
+            return self.metric.comparison_sign * s
+
+        best_idx, best = max(enumerate(scores), key=_order_key)
         result = MetricEvaluatorResult(
             best_score=best,
             best_engine_params=best.engine_params,
@@ -162,8 +169,19 @@ class MetricEvaluator:
         """best.json: the winning variant's params, re-loadable as an
         engine.json params subtree (MetricEvaluator.saveEngineJson:193-217)."""
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        ep = result.best_engine_params
+
+        def p2d(p):
+            return dataclasses.asdict(p) if dataclasses.is_dataclass(p) else {}
+
+        variant = {
+            "datasource": {"params": p2d(ep.data_source_params)},
+            "preparator": {"params": p2d(ep.preparator_params)},
+            "algorithms": [
+                {"name": n, "params": p2d(p)}
+                for n, p in ep.algorithm_params_list],
+            "serving": {"params": p2d(ep.serving_params)},
+        }
         with open(path, "w") as f:
-            json.dump(
-                _engine_params_to_dict(result.best_engine_params), f,
-                indent=2, default=str)
+            json.dump(variant, f, indent=2, default=str)
         logger.info("Best engine params written to %s", path)

@@ -315,7 +315,7 @@ def rmse(U, V, user_idx, item_idx, rating, mask, chunk: int = 1 << 18):
     """Root-mean-square error over observed (possibly padded) entries."""
     nnz_pad = user_idx.shape[0]
     n_chunks = max(-(-nnz_pad // chunk), 1)
-    target = n_chunks * chunk if n_chunks * chunk >= nnz_pad else nnz_pad
+    target = n_chunks * chunk
     if target != nnz_pad:
         extra = target - nnz_pad
         user_idx = jnp.pad(user_idx, (0, extra))
