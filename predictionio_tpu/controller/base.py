@@ -14,7 +14,12 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import importlib
 from typing import Any, Dict, Generic, Iterable, List, Optional, Sequence, Tuple, TypeVar
+
+from predictionio_tpu.controller.persistent_model import (
+    PersistentModel, PersistentModelManifest,
+)
 
 TD = TypeVar("TD")   # training data
 PD = TypeVar("PD")   # prepared data
@@ -58,13 +63,19 @@ class SanityCheck(abc.ABC):
 
 def create_doer(cls, params: Optional[Params]):
     """Instantiate a DASE class with its Params — 1-arg ctor or 0-arg
-    fallback (core/.../core/AbstractDoer.scala:29-69)."""
+    fallback (core/.../core/AbstractDoer.scala:29-69). The params are also
+    recorded on the instance (`_pio_params`) so persistence hooks see them
+    regardless of what attribute name the subclass's ctor used."""
     if params is None or isinstance(params, EmptyParams):
         try:
-            return cls()
+            obj = cls()
         except TypeError:
-            return cls(params if params is not None else EmptyParams())
-    return cls(params)
+            obj = cls(params if params is not None else EmptyParams())
+    else:
+        obj = cls(params)
+    object.__setattr__(  # works for frozen-dataclass components too
+        obj, "_pio_params", params if params is not None else EmptyParams())
+    return obj
 
 
 class DataSource(Generic[TD, EI, Q, A], abc.ABC):
@@ -113,16 +124,50 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
         return [(qx, self.predict(model, q)) for qx, q in queries]
 
     # -- persistence hooks (BaseAlgorithm.makePersistentModel) --------------
-    def make_persistent_model(self, ctx, model: M) -> Any:
-        """Return the object to persist for this model; default the model
-        itself. Return a PersistentModelManifest-like marker for
-        self-managed saves (controller/PersistentModel.scala)."""
+    def make_persistent_model(self, ctx, instance_id: str, model: M) -> Any:
+        """Return the object to persist for this model
+        (Engine.makeSerializableModels, Engine.scala:286-304): models
+        implementing PersistentModel self-save and are replaced by a
+        manifest naming their loader; everything else persists as-is via
+        the default blob path."""
+        if isinstance(model, PersistentModel):
+            manifest = PersistentModelManifest(
+                class_name=type(model).__qualname__,
+                module_name=type(model).__module__)
+            # validate BEFORE save so an unservable class fails fast with
+            # the real reason rather than a pickle/storage error
+            _check_manifest_loadable(manifest, type(model))
+            if model.save(instance_id, getattr(self, "_pio_params", None), ctx):
+                return manifest
         return model
 
     @property
     def query_class(self):
         """Optional override: the Query dataclass for JSON extraction."""
         return None
+
+
+def _check_manifest_loadable(manifest: PersistentModelManifest,
+                             model_cls: type) -> None:
+    """Fail at save time, not deploy time, if the manifest can never be
+    resolved by a fresh server process (class defined in __main__ or a
+    local scope, or not importable by its recorded path)."""
+    if manifest.module_name == "__main__" or "<locals>" in manifest.class_name:
+        raise ValueError(
+            f"PersistentModel class {model_cls!r} is not importable from a "
+            "deploy process (defined in __main__ or a local scope); move it "
+            "into an importable module")
+    obj = importlib.import_module(manifest.module_name)
+    for part in manifest.class_name.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            raise ValueError(
+                f"PersistentModel manifest {manifest.module_name}:"
+                f"{manifest.class_name} does not resolve back to a class")
+    if obj is not model_cls:
+        raise ValueError(
+            f"PersistentModel manifest {manifest.module_name}:"
+            f"{manifest.class_name} resolves to {obj!r}, not {model_cls!r}")
 
 
 class Serving(Generic[Q, P], abc.ABC):

@@ -125,6 +125,17 @@ class Engine:
         logger.info("EngineWorkflow.train completed")
         return models
 
+    def make_serializable_models(self, ctx, instance_id: str,
+                                 engine_params: EngineParams,
+                                 models: List[Any]) -> List[Any]:
+        """Run each algorithm's persistence hook over its trained model
+        (Engine.makeSerializableModels, Engine.scala:286-304). Algorithm
+        instances are Doer-constructed from params (reference semantics:
+        components must be reconstructible from their Params alone)."""
+        _, _, algorithms, _ = self._instantiate(engine_params)
+        return [a.make_persistent_model(ctx, instance_id, m)
+                for a, m in zip(algorithms, models)]
+
     @staticmethod
     def _sanity_check(obj, params) -> None:
         if getattr(params, "skip_sanity_check", False):

@@ -1,0 +1,82 @@
+"""Thin HTTP transport for pure route handlers.
+
+Any object with `handle(method, path, query, body, headers) -> (status,
+payload)` can be served. Threaded stdlib server — the daemons are I/O
+bound; heavy compute happens in the workflow processes, mirroring the
+reference's spray actors over a dispatcher (EventServer.scala:602-663).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api = None  # set by make_server
+    protocol_version = "HTTP/1.1"
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlsplit(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query,
+                                            keep_blank_values=True))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        status, payload = self.api.handle(
+            method, parsed.path, query, body, dict(self.headers.items()))
+        data = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    def do_PUT(self):  # noqa: N802
+        self._dispatch("PUT")
+
+    def log_message(self, fmt, *args):  # route logs through logging, quietly
+        import logging
+        logging.getLogger("predictionio_tpu.http").debug(fmt, *args)
+
+
+def make_server(api, host: str = "localhost",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Build (without starting) a threaded HTTP server around `api`.
+
+    port=0 binds an ephemeral port; read it from server.server_address.
+    """
+    handler = type("BoundHandler", (_Handler,), {"api": api})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    return server
+
+
+def serve_background(api, host: str = "localhost",
+                     port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
+    """Start `api` on a daemon thread; returns (server, bound_port)."""
+    server = make_server(api, host, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+def serve_forever(api, host: str = "localhost", port: int = 7070) -> None:
+    server = make_server(api, host, port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()

@@ -1,0 +1,73 @@
+"""Event-server plugin SPI.
+
+Reference: data/.../api/EventServerPlugin.scala:21-30 and
+EventServerPluginContext.scala — two plugin kinds, "inputblocker" (runs
+synchronously in the request path, may raise to reject an event) and
+"inputsniffer" (observes asynchronously). Discovery via Python entry-point
+style registration instead of java.util.ServiceLoader.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+
+logger = logging.getLogger("predictionio_tpu.api.plugins")
+
+INPUT_BLOCKER = "inputblocker"
+INPUT_SNIFFER = "inputsniffer"
+
+
+class EventInfo:
+    """The payload handed to plugins (EventServerPlugin.process signature)."""
+
+    def __init__(self, app_id: int, channel_id: Optional[int], event: Event):
+        self.app_id = app_id
+        self.channel_id = channel_id
+        self.event = event
+
+
+class EventServerPlugin:
+    """Subclass and set plugin_name/plugin_description/plugin_type."""
+
+    plugin_name = ""
+    plugin_description = ""
+    plugin_type = INPUT_SNIFFER
+
+    def process(self, event_info: EventInfo, context) -> None:
+        """Blockers raise to reject; sniffers observe."""
+
+    def handle_rest(self, app_id: int, channel_id: Optional[int],
+                    args: Sequence[str]) -> str:
+        """Answer GET /plugins/<type>/<name>/... (returns a JSON string)."""
+        return "{}"
+
+
+class EventServerPluginContext:
+    """Plugin registry (EventServerPluginContext.scala:40-91)."""
+
+    def __init__(self, plugins: Sequence[EventServerPlugin] = ()):
+        self.input_blockers: Dict[str, EventServerPlugin] = {}
+        self.input_sniffers: Dict[str, EventServerPlugin] = {}
+        for p in plugins:
+            self.register(p)
+
+    def register(self, plugin: EventServerPlugin) -> None:
+        target = (self.input_blockers
+                  if plugin.plugin_type == INPUT_BLOCKER
+                  else self.input_sniffers)
+        target[plugin.plugin_name] = plugin
+
+    def describe(self) -> Dict[str, Dict[str, Dict[str, str]]]:
+        def block(ps: Dict[str, EventServerPlugin]):
+            return {
+                n: {"name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__}
+                for n, p in ps.items()}
+        return {"plugins": {
+            "inputblockers": block(self.input_blockers),
+            "inputsniffers": block(self.input_sniffers),
+        }}

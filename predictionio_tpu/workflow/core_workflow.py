@@ -63,6 +63,8 @@ def run_train(
     logger.info("EngineInstance %s created (INIT)", instance_id)
     try:
         models = engine.train(ctx, engine_params)
+        models = engine.make_serializable_models(
+            ctx, instance_id, engine_params, models)
         blob = model_io.serialize_models(models)
         storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
         row = instances.get(instance_id)

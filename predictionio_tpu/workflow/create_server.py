@@ -1,0 +1,361 @@
+"""The engine (deploy) server.
+
+Reference: core/.../workflow/CreateServer.scala:105-697. The daemon loads
+the latest COMPLETED EngineInstance's engine + models, pushes model arrays
+into device memory (prepare_deploy), and answers:
+
+  GET  /             -> status (engine instance info + serving stats)
+  POST /queries.json -> supplement -> predict per algorithm -> serve
+  POST /reload       -> hot-swap to the latest COMPLETED instance
+  POST /stop         -> shut the server down
+  GET  /plugins.json -> plugin inventory
+  GET  /plugins/<type>/<name>/... -> plugin REST handoff
+
+The query hot path never touches the host-side event store for ALS-style
+models: factors stay device-resident between requests (BASELINE.json
+north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import random
+import string
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.persistent_model import PersistentModelManifest
+from predictionio_tpu.data.event import format_event_time, utcnow
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.workflow import json_extractor, model_io
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
+from predictionio_tpu.workflow.workflow_utils import get_engine, load_object
+
+logger = logging.getLogger("predictionio_tpu.server")
+
+Response = Tuple[int, Any]
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """CreateServer args (CreateServer.scala:77-103)."""
+    engine_instance_id: Optional[str] = None
+    engine_id: str = "default"
+    engine_version: str = "NOT_USED"
+    engine_variant: str = "default"
+    engine_dir: Optional[str] = None
+    ip: str = "localhost"
+    port: int = 8000
+    feedback: bool = False
+    event_server_ip: str = "localhost"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None
+    verbose: bool = False
+
+
+def resolve_engine_instance(storage: Storage, config: ServerConfig):
+    """Latest COMPLETED instance unless one is pinned
+    (commands/Engine.scala:224-239)."""
+    instances = storage.get_meta_data_engine_instances()
+    if config.engine_instance_id:
+        instance = instances.get(config.engine_instance_id)
+        if instance is None:
+            raise ValueError(
+                f"EngineInstance {config.engine_instance_id} not found")
+        if instance.status != "COMPLETED":
+            raise ValueError(
+                f"EngineInstance {instance.id} is {instance.status}, not "
+                "COMPLETED; cannot deploy")
+        return instance
+    instance = instances.get_latest_completed(
+        config.engine_id, config.engine_version, config.engine_variant)
+    if instance is None:
+        raise ValueError(
+            "No valid engine instance found for engine "
+            f"{config.engine_id} {config.engine_version} "
+            f"{config.engine_variant}. Try running `pio train` first.")
+    return instance
+
+
+def engine_params_from_instance(engine: Engine, instance) -> EngineParams:
+    """Rebuild EngineParams from the ledger row's JSON snapshots
+    (Engine.engineInstanceToEngineParams, Engine.scala:422-492)."""
+    def subtree(raw):
+        obj = json.loads(raw or "{}")
+        # rows hold either the {"params": {...}} subtree (as snapshotted
+        # from engine.json by run_train) or bare params
+        return obj if (not obj or "params" in obj) else {"params": obj}
+
+    variant = {
+        "datasource": subtree(instance.data_source_params),
+        "preparator": subtree(instance.preparator_params),
+        "serving": subtree(instance.serving_params),
+    }
+    algos = json.loads(instance.algorithms_params or "[]")
+    if algos:
+        variant["algorithms"] = algos
+    return engine.engine_params_from_json(variant)
+
+
+def prepare_deploy(ctx, engine: Engine, engine_params: EngineParams,
+                   instance_id: str, models: List[Any]) -> List[Any]:
+    """Make persisted models servable (Engine.prepareDeploy,
+    Engine.scala:199-269): manifest -> user loader; None -> retrain;
+    otherwise device_put the blob's arrays back into HBM."""
+    _, _, algorithms, _ = engine._instantiate(engine_params)
+    out = []
+    retrained: Optional[List[Any]] = None
+    for i, (algo, model) in enumerate(zip(algorithms, models)):
+        if isinstance(model, PersistentModelManifest):
+            loader = load_object(f"{model.module_name}:{model.class_name}")
+            out.append(loader.load(
+                instance_id, getattr(algo, "_pio_params", None), ctx))
+        elif model is None:
+            # un-persistable model: retrain on deploy (Engine.scala:211-229)
+            if retrained is None:
+                logger.info("Some models cannot be loaded; retraining.")
+                retrained = engine.train(ctx, engine_params)
+            out.append(retrained[i])
+        else:
+            out.append(model_io.device_put_tree(model))
+    return out
+
+
+class QueryAPI:
+    """Pure route handler for the engine server (ServerActor routes,
+    CreateServer.scala:384-693)."""
+
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 storage: Optional[Storage] = None,
+                 ctx: Optional[WorkflowContext] = None,
+                 plugin_context: Optional[EngineServerPluginContext] = None,
+                 engine: Optional[Engine] = None):
+        self.config = config or ServerConfig()
+        self.storage = storage or get_storage()
+        self.ctx = ctx or WorkflowContext(storage=self.storage)
+        self.plugin_context = plugin_context or EngineServerPluginContext()
+        self._engine_override = engine
+        self._lock = threading.Lock()
+        self._stop_requested = threading.Event()
+        # serving stats (CreateServer.scala:399-401)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = utcnow()
+        self._load()
+
+    # ------------------------------------------------------------- loading
+    def _load(self) -> None:
+        instance = resolve_engine_instance(self.storage, self.config)
+        engine = self._engine_override or get_engine(
+            instance.engine_factory, base_dir=self.config.engine_dir)
+        engine_params = engine_params_from_instance(engine, instance)
+        blob = self.storage.get_model_data_models().get(instance.id)
+        if blob is None:
+            raise ValueError(f"No model data for EngineInstance {instance.id}")
+        models = model_io.deserialize_models(blob.models)
+        models = prepare_deploy(
+            self.ctx, engine, engine_params, instance.id, models)
+        _, _, algorithms, serving = engine._instantiate(engine_params)
+        with self._lock:
+            self.engine_instance = instance
+            self.engine = engine
+            self.engine_params = engine_params
+            self.algorithms = algorithms
+            self.models = models
+            self.serving = serving
+        logger.info("Engine instance %s deployed (%d algorithm(s))",
+                    instance.id, len(algorithms))
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested.is_set()
+
+    # ------------------------------------------------------------ dispatch
+    def handle(self, method: str, path: str,
+               query: Optional[Dict[str, str]] = None,
+               body: bytes = b"",
+               headers: Optional[Dict[str, str]] = None) -> Response:
+        method = method.upper()
+        path = (path or "/").rstrip("/") or "/"
+        try:
+            if path == "/" and method == "GET":
+                return 200, self._status()
+            if path == "/queries.json" and method == "POST":
+                return self._queries(body)
+            if path == "/reload" and method == "POST":
+                threading.Thread(target=self._reload, daemon=True).start()
+                return 200, {"message": "Reloading..."}
+            if path == "/stop" and method == "POST":
+                self._stop_requested.set()
+                return 200, {"message": "Shutting down."}
+            if path == "/plugins.json" and method == "GET":
+                return 200, self.plugin_context.describe()
+            if path.startswith("/plugins/") and method == "GET":
+                return self._plugins_rest(path)
+            return 404, {"message": "Not Found"}
+        except Exception as e:
+            logger.exception("engine server request failed: %s %s",
+                             method, path)
+            return 500, {"message": str(e)}
+
+    def _status(self) -> Dict[str, Any]:
+        i = self.engine_instance
+        return {
+            "status": "alive",
+            "engineInstance": {
+                "id": i.id,
+                "engineFactory": i.engine_factory,
+                "startTime": format_event_time(i.start_time),
+                "batch": i.batch,
+            },
+            "algorithms": [type(a).__name__ for a in self.algorithms],
+            "requestCount": self.request_count,
+            "avgServingSec": self.avg_serving_sec,
+            "lastServingSec": self.last_serving_sec,
+            "serverStartTime": format_event_time(self.start_time),
+        }
+
+    def _reload(self) -> None:
+        try:
+            self._load()
+        except Exception:
+            logger.exception("reload failed; keeping previous engine")
+
+    # ---------------------------------------------------------- query path
+    def _queries(self, body: bytes) -> Response:
+        t0 = time.perf_counter()
+        query_time = utcnow()
+        with self._lock:
+            algorithms, models, serving = (
+                self.algorithms, self.models, self.serving)
+            instance = self.engine_instance
+        try:
+            query = json_extractor.extract_query(
+                getattr(algorithms[0], "query_class", None), body)
+        except (ValueError, UnicodeDecodeError) as e:
+            return 400, {"message": str(e)}
+        supplemented = serving.supplement(query)
+        predictions = [a.predict(m, supplemented)
+                       for a, m in zip(algorithms, models)]
+        prediction = serving.serve(query, predictions)
+        result = json_extractor.to_json_obj(prediction)
+
+        if self.config.feedback:
+            result = self._feedback(instance, query, prediction, result,
+                                    query_time)
+
+        for blocker in self.plugin_context.output_blockers.values():
+            result = blocker.process(
+                instance, json_extractor.to_json_obj(query), result,
+                self.plugin_context)
+
+        dt = time.perf_counter() - t0
+        self.last_serving_sec = dt
+        self.avg_serving_sec = (
+            (self.avg_serving_sec * self.request_count) + dt
+        ) / (self.request_count + 1)
+        self.request_count += 1
+        return 200, result
+
+    def _feedback(self, instance, query, prediction, result,
+                  query_time) -> Dict[str, Any]:
+        """Async prediction feedback to the event server
+        (CreateServer.scala:514-576)."""
+        pr_id = getattr(prediction, "prId", "") or "".join(
+            random.SystemRandom().choice(string.ascii_letters + string.digits)
+            for _ in range(64))
+        data = {
+            "event": "predict",
+            "eventTime": format_event_time(query_time),
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {
+                "engineInstanceId": instance.id,
+                "query": json_extractor.to_json_obj(query),
+                "prediction": result,
+            },
+        }
+        if getattr(query, "prId", None):
+            data["prId"] = query.prId
+        url = (f"http://{self.config.event_server_ip}:"
+               f"{self.config.event_server_port}/events.json"
+               f"?accessKey={self.config.access_key or ''}")
+
+        def post():
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(data).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    if r.status != 201:
+                        logger.error("Feedback event failed. Status code: %s",
+                                     r.status)
+            except Exception as e:
+                logger.error("Feedback event failed: %s", e)
+
+        threading.Thread(target=post, daemon=True).start()
+        # inject prId into the served result (CreateServer.scala:568-576)
+        if hasattr(prediction, "prId"):
+            result = dict(result)
+            result["prId"] = pr_id
+        return result
+
+    def _plugins_rest(self, path: str) -> Response:
+        segments = [s for s in path.split("/") if s][1:]
+        if len(segments) < 2:
+            return 404, {"message": "Not Found"}
+        plugin_type, plugin_name, *args = segments
+        registry = {
+            "outputblocker": self.plugin_context.output_blockers,
+            "outputsniffer": self.plugin_context.output_sniffers,
+        }.get(plugin_type)
+        if registry is None or plugin_name not in registry:
+            return 404, {"message": "Not Found"}
+        out = registry[plugin_name].handle_rest(args)
+        try:
+            return 200, json.loads(out)
+        except ValueError:
+            return 200, {"result": out}
+
+
+def undeploy(ip: str, port: int) -> bool:
+    """POST /stop to a running engine server (commands/Engine.scala:240+)."""
+    try:
+        req = urllib.request.Request(
+            f"http://{ip}:{port}/stop", data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status == 200
+    except Exception:
+        return False
+
+
+def serve(api: QueryAPI, host: str = "localhost", port: int = 8000,
+          bind_retries: int = 3) -> None:
+    """Run until /stop (MasterActor bind + retry, CreateServer.scala:347-357)."""
+    from predictionio_tpu.data.api.http import make_server
+    server = None
+    for attempt in range(bind_retries):
+        try:
+            server = make_server(api, host, port)
+            break
+        except OSError:
+            if attempt == bind_retries - 1:
+                raise
+            logger.warning("Bind failed; retrying in 1s...")
+            time.sleep(1)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    logger.info("Engine server online at http://%s:%s", host, port)
+    try:
+        while not api.stop_requested:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    server.shutdown()

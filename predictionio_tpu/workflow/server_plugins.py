@@ -1,0 +1,57 @@
+"""Engine-server plugin SPI.
+
+Reference: core/.../workflow/EngineServerPlugin.scala:24-40 and
+EngineServerPluginContext.scala:40-91 — "outputblocker" plugins transform
+(or veto) each prediction synchronously; "outputsniffer" plugins observe
+asynchronously and can answer REST calls under /plugins/.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+OUTPUT_BLOCKER = "outputblocker"
+OUTPUT_SNIFFER = "outputsniffer"
+
+
+class EngineServerPlugin:
+    plugin_name = ""
+    plugin_description = ""
+    plugin_type = OUTPUT_SNIFFER
+
+    def process(self, engine_instance, query_obj, prediction_obj, context):
+        """Blockers return the (possibly rewritten) prediction JSON object;
+        sniffers' return value is ignored."""
+        return prediction_obj
+
+    def handle_rest(self, args: Sequence[str]) -> str:
+        return "{}"
+
+    def start(self, context) -> None:
+        """Called once when the server starts (EngineServerPlugin.start)."""
+
+
+class EngineServerPluginContext:
+    def __init__(self, plugins: Sequence[EngineServerPlugin] = ()):
+        self.output_blockers: Dict[str, EngineServerPlugin] = {}
+        self.output_sniffers: Dict[str, EngineServerPlugin] = {}
+        for p in plugins:
+            self.register(p)
+
+    def register(self, plugin: EngineServerPlugin) -> None:
+        target = (self.output_blockers
+                  if plugin.plugin_type == OUTPUT_BLOCKER
+                  else self.output_sniffers)
+        target[plugin.plugin_name] = plugin
+
+    def describe(self) -> Dict[str, Dict[str, Dict[str, str]]]:
+        def block(ps):
+            return {
+                n: {"name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__}
+                for n, p in ps.items()}
+        return {"plugins": {
+            "outputblockers": block(self.output_blockers),
+            "outputsniffers": block(self.output_sniffers),
+        }}

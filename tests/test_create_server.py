@@ -1,0 +1,233 @@
+"""Engine (deploy) server tests — CreateServer parity: instance resolution,
+model load + device placement, /queries.json hot path, /reload hot-swap,
+/stop, feedback loop to a live event server (CreateServer.scala:105-697)."""
+
+import dataclasses
+import json
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.data.api import EventAPI
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import AccessKey, App
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+)
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import (
+    QueryAPI, ServerConfig, engine_params_from_instance,
+    resolve_engine_instance, undeploy,
+)
+from predictionio_tpu.workflow.server_plugins import (
+    OUTPUT_BLOCKER, EngineServerPlugin, EngineServerPluginContext,
+)
+
+
+@pytest.fixture()
+def trained(memory_storage):
+    """App with events + one COMPLETED EngineInstance."""
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "MyApp1", None))
+    memory_storage.get_events().init(app_id)
+    import datetime as dt
+    from predictionio_tpu.data import store
+    events = []
+    minute = 0
+    for u in range(8):
+        for i in range(6):
+            minute += 1
+            r = 5.0 if (u % 2) == (i % 2) else 1.0
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": r}),
+                event_time=dt.datetime(2021, 1, 1, 0, minute % 60,
+                                       tzinfo=dt.timezone.utc)))
+    store.write(events, app_id, storage=memory_storage)
+
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="MyApp1"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=4, numIterations=5,
+                                       lambda_=0.05, seed=3)),))
+    ctx = WorkflowContext(storage=memory_storage)
+    instance_id = run_train(
+        ctx, engine, ep,
+        engine_factory=("predictionio_tpu.models.recommendation"
+                        ":RecommendationEngine"),
+        params_json={
+            "datasource": {"params": {"appName": "MyApp1"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "numIterations": 5, "lambda": 0.05, "seed": 3}}],
+        })
+    return memory_storage, app_id, instance_id
+
+
+def test_resolve_and_params_roundtrip(trained):
+    storage, _app_id, instance_id = trained
+    instance = resolve_engine_instance(storage, ServerConfig())
+    assert instance.id == instance_id and instance.status == "COMPLETED"
+    ep = engine_params_from_instance(RecommendationEngine(), instance)
+    assert ep.data_source_params.appName == "MyApp1"
+    name, ap = ep.algorithm_params_list[0]
+    assert name == "als" and ap.rank == 4 and ap.lambda_ == 0.05
+
+    with pytest.raises(ValueError, match="not found"):
+        resolve_engine_instance(
+            storage, ServerConfig(engine_instance_id="missing"))
+
+
+def test_resolve_refuses_incomplete(memory_storage):
+    with pytest.raises(ValueError, match="No valid engine instance"):
+        resolve_engine_instance(memory_storage, ServerConfig())
+
+
+def test_query_roundtrip_and_status(trained):
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    status, body = api.handle(
+        "POST", "/queries.json", body=json.dumps(
+            {"user": "u1", "num": 4}).encode())
+    assert status == 200
+    assert len(body["itemScores"]) == 4
+    scores = [s["score"] for s in body["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+    # odd user should prefer odd items (the training signal)
+    assert body["itemScores"][0]["item"] in {"i1", "i3", "i5"}
+
+    # unknown user -> empty itemScores, not an error
+    status, body = api.handle(
+        "POST", "/queries.json", body=json.dumps(
+            {"user": "nobody", "num": 4}).encode())
+    assert status == 200 and body == {"itemScores": []}
+
+    # malformed query -> 400
+    status, _ = api.handle("POST", "/queries.json", body=b"{")
+    assert status == 400
+    status, _ = api.handle(
+        "POST", "/queries.json", body=json.dumps({"user": "u1"}).encode())
+    assert status == 400
+
+    status, info = api.handle("GET", "/")
+    assert status == 200 and info["requestCount"] == 2
+    assert info["engineInstance"]["id"] == _iid
+    assert info["avgServingSec"] > 0
+
+
+def test_reload_hot_swap(trained):
+    storage, app_id, first_id = trained
+    api = QueryAPI(storage=storage)
+    assert api.engine_instance.id == first_id
+
+    # train a second instance, then hot-swap
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="MyApp1"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=3, numIterations=4,
+                                       lambda_=0.05, seed=5)),))
+    second_id = run_train(
+        WorkflowContext(storage=storage), engine, ep,
+        engine_factory=("predictionio_tpu.models.recommendation"
+                        ":RecommendationEngine"),
+        params_json={"datasource": {"params": {"appName": "MyApp1"}},
+                     "algorithms": [{"name": "als", "params": {
+                         "rank": 3, "numIterations": 4, "lambda": 0.05,
+                         "seed": 5}}]})
+    status, _ = api.handle("POST", "/reload")
+    assert status == 200
+    for _ in range(100):
+        if api.engine_instance.id == second_id:
+            break
+        time.sleep(0.05)
+    assert api.engine_instance.id == second_id
+    status, body = api.handle(
+        "POST", "/queries.json",
+        body=json.dumps({"user": "u1", "num": 2}).encode())
+    assert status == 200 and len(body["itemScores"]) == 2
+
+
+def test_stop_flag_and_undeploy(trained):
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    assert not api.stop_requested
+    status, body = api.handle("POST", "/stop")
+    assert status == 200 and not undeploy("localhost", 1)  # nothing listening
+    assert api.stop_requested
+
+
+def test_output_blocker_plugin(trained):
+    storage, _app_id, _iid = trained
+
+    class Cap(EngineServerPlugin):
+        plugin_name = "cap"
+        plugin_description = "keeps only the top result"
+        plugin_type = OUTPUT_BLOCKER
+
+        def process(self, engine_instance, query_obj, prediction_obj, context):
+            return {"itemScores": prediction_obj["itemScores"][:1]}
+
+    api = QueryAPI(storage=storage,
+                   plugin_context=EngineServerPluginContext([Cap()]))
+    status, body = api.handle(
+        "POST", "/queries.json",
+        body=json.dumps({"user": "u1", "num": 4}).encode())
+    assert status == 200 and len(body["itemScores"]) == 1
+    status, desc = api.handle("GET", "/plugins.json")
+    assert "cap" in desc["plugins"]["outputblockers"]
+
+
+def test_feedback_loop_to_event_server(trained):
+    storage, app_id, instance_id = trained
+    storage.get_meta_data_access_keys().insert(AccessKey("fk", app_id, ()))
+    event_api = EventAPI(storage=storage)
+    server, port = serve_background(event_api)
+    try:
+        api = QueryAPI(
+            storage=storage,
+            config=ServerConfig(feedback=True, event_server_port=port,
+                                access_key="fk"))
+        status, _body = api.handle(
+            "POST", "/queries.json",
+            body=json.dumps({"user": "u1", "num": 2}).encode())
+        assert status == 200
+        # wait for the async feedback POST to land
+        got = None
+        for _ in range(100):
+            sts, got = event_api.handle(
+                "GET", "/events.json",
+                {"accessKey": "fk", "entityType": "pio_pr"})
+            if sts == 200:
+                break
+            time.sleep(0.05)
+        assert sts == 200 and len(got) == 1
+        fb = got[0]
+        assert fb["event"] == "predict"
+        props = fb["properties"]
+        assert props["engineInstanceId"] == instance_id
+        assert props["query"] == {"user": "u1", "num": 2}
+        assert len(props["prediction"]["itemScores"]) == 2
+    finally:
+        server.shutdown()
+
+
+def test_http_transport_smoke(trained):
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    server, port = serve_background(api)
+    try:
+        req = urllib.request.Request(
+            f"http://localhost:{port}/queries.json",
+            data=json.dumps({"user": "u2", "num": 3}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            assert len(json.loads(r.read())["itemScores"]) == 3
+    finally:
+        server.shutdown()

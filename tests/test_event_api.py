@@ -1,0 +1,276 @@
+"""Event Server route tests (ref: data/src/test/scala/.../api/EventServiceSpec.scala
+and webhooks/*Spec.scala — spray-testkit route tests against an in-memory
+LEvents; here the pure EventAPI handler is exercised directly, plus one
+socket smoke test)."""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api import EventAPI, EventServerConfig
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.data.api.plugins import (
+    INPUT_BLOCKER, EventServerPlugin, EventServerPluginContext,
+)
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def api(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "testapp", None))
+    memory_storage.get_events().init(app_id)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("secret", app_id, ()))
+    api = EventAPI(storage=memory_storage)
+    api.app_id = app_id
+    return api
+
+
+def ev(name="rate", entity="u0", **kw):
+    d = {"event": name, "entityType": "user", "entityId": entity}
+    d.update(kw)
+    return json.dumps(d).encode()
+
+
+def test_alive_and_unknown_route(api):
+    assert api.handle("GET", "/") == (200, {"status": "alive"})
+    status, _ = api.handle("GET", "/nope.json")
+    assert status == 404
+
+
+def test_auth_missing_invalid_and_basic_header(api):
+    status, body = api.handle("POST", "/events.json", {}, ev())
+    assert status == 401 and "Missing" in body["message"]
+    status, _ = api.handle("POST", "/events.json", {"accessKey": "wrong"}, ev())
+    assert status == 401
+    # Basic auth: key as username (EventServer.scala:115-127)
+    hdr = {"Authorization":
+           "Basic " + base64.b64encode(b"secret:").decode()}
+    status, body = api.handle("POST", "/events.json", {}, ev(), hdr)
+    assert status == 201 and "eventId" in body
+
+
+def test_post_get_delete_event(api):
+    q = {"accessKey": "secret"}
+    status, body = api.handle("POST", "/events.json", q, ev())
+    assert status == 201
+    eid = body["eventId"]
+    status, got = api.handle("GET", f"/events/{eid}.json", q)
+    assert status == 200 and got["event"] == "rate" and got["eventId"] == eid
+    status, body = api.handle("DELETE", f"/events/{eid}.json", q)
+    assert (status, body) == (200, {"message": "Found"})
+    status, _ = api.handle("GET", f"/events/{eid}.json", q)
+    assert status == 404
+    status, _ = api.handle("DELETE", f"/events/{eid}.json", q)
+    assert status == 404
+
+
+def test_malformed_event_400(api):
+    q = {"accessKey": "secret"}
+    status, _ = api.handle("POST", "/events.json", q, b"{not json")
+    assert status == 400
+    status, body = api.handle("POST", "/events.json", q,
+                              json.dumps({"event": "rate"}).encode())
+    assert status == 400 and "entityType" in body["message"]
+
+
+def test_allowed_events_enforcement(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "app2", None))
+    memory_storage.get_events().init(app_id)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("limited", app_id, ("view",)))
+    api = EventAPI(storage=memory_storage)
+    q = {"accessKey": "limited"}
+    status, body = api.handle("POST", "/events.json", q, ev("rate"))
+    assert status == 403 and "not allowed" in body["message"]
+    status, _ = api.handle("POST", "/events.json", q, ev("view"))
+    assert status == 201
+
+
+def test_get_events_filters_and_limit(api):
+    q = {"accessKey": "secret"}
+    for n in range(25):
+        api.handle("POST", "/events.json", q, ev(
+            "rate", f"u{n}", eventTime=f"2021-01-01T00:{n:02d}:00.000Z"))
+    # default limit 20 (EventServer.scala:353)
+    status, body = api.handle("GET", "/events.json", q)
+    assert status == 200 and len(body) == 20
+    status, body = api.handle("GET", "/events.json", dict(q, limit="-1"))
+    assert len(body) == 25
+    status, body = api.handle(
+        "GET", "/events.json", dict(q, entityId="u3", entityType="user"))
+    assert len(body) == 1 and body[0]["entityId"] == "u3"
+    # time-window filter
+    status, body = api.handle("GET", "/events.json", dict(
+        q, startTime="2021-01-01T00:10:00.000Z",
+        untilTime="2021-01-01T00:12:00.000Z"))
+    assert [e["entityId"] for e in body] == ["u10", "u11"]
+    # empty result -> 404 (EventServer.scala:356-360)
+    status, body = api.handle(
+        "GET", "/events.json", dict(q, entityId="zzz", entityType="user"))
+    assert status == 404
+    # reversed requires entityType+entityId
+    status, body = api.handle("GET", "/events.json", dict(q, reversed="true"))
+    assert status == 400
+    status, body = api.handle("GET", "/events.json", dict(
+        q, reversed="true", entityType="user", entityId="u3"))
+    assert status == 200
+
+
+def test_batch_events(api):
+    q = {"accessKey": "secret"}
+    items = [
+        {"event": "rate", "entityType": "user", "entityId": "a"},
+        {"event": "rate"},  # malformed
+        {"event": "buy", "entityType": "user", "entityId": "b"},
+    ]
+    status, results = api.handle("POST", "/batch/events.json", q,
+                                 json.dumps(items).encode())
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 400, 201]
+    # cap at 50 (EventServer.scala:70)
+    too_many = [{"event": "e", "entityType": "user", "entityId": "x"}] * 51
+    status, body = api.handle("POST", "/batch/events.json", q,
+                              json.dumps(too_many).encode())
+    assert status == 400 and "50" in body["message"]
+
+
+def test_channel_auth_and_separation(api, memory_storage):
+    cid = memory_storage.get_meta_data_channels().insert(
+        Channel(0, "mobile", api.app_id))
+    memory_storage.get_events().init(api.app_id, cid)
+    status, body = api.handle(
+        "POST", "/events.json",
+        {"accessKey": "secret", "channel": "nope"}, ev())
+    assert status == 401 and "Invalid channel" in body["message"]
+    q = {"accessKey": "secret", "channel": "mobile"}
+    status, _ = api.handle("POST", "/events.json", q, ev("tap", "u9"))
+    assert status == 201
+    # default channel does not see it
+    status, _ = api.handle("GET", "/events.json", {"accessKey": "secret"})
+    assert status == 404
+    status, body = api.handle("GET", "/events.json", q)
+    assert len(body) == 1 and body[0]["event"] == "tap"
+
+
+def test_stats_route(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "app3", None))
+    memory_storage.get_events().init(app_id)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("k3", app_id, ()))
+    off = EventAPI(storage=memory_storage)
+    status, body = off.handle("GET", "/stats.json", {"accessKey": "k3"})
+    assert status == 404 and "--stats" in body["message"]
+
+    on = EventAPI(storage=memory_storage,
+                  config=EventServerConfig(stats=True))
+    on.handle("POST", "/events.json", {"accessKey": "k3"}, ev())
+    status, snap = on.handle("GET", "/stats.json", {"accessKey": "k3"})
+    assert status == 200
+    basic = snap["longLive"]["basic"]
+    assert basic == [{"key": {"entityType": "user", "targetEntityType": None,
+                              "event": "rate"}, "value": 1}]
+    assert snap["longLive"]["statusCode"] == [{"key": 201, "value": 1}]
+
+
+def test_webhooks_segmentio(api):
+    q = {"accessKey": "secret"}
+    payload = {
+        "version": "2", "type": "track", "user_id": "alice",
+        "event": "Signed Up", "properties": {"plan": "Pro"},
+        "timestamp": "2021-03-04T05:06:07.000Z",
+    }
+    status, body = api.handle("POST", "/webhooks/segmentio.json", q,
+                              json.dumps(payload).encode())
+    assert status == 201
+    status, got = api.handle("GET", f"/events/{body['eventId']}.json", q)
+    assert got["event"] == "track"
+    assert got["entityId"] == "alice"
+    assert got["properties"]["event"] == "Signed Up"
+    assert got["eventTime"] == "2021-03-04T05:06:07.000Z"
+    # presence checks + unsupported connector
+    assert api.handle("GET", "/webhooks/segmentio.json", q)[0] == 200
+    assert api.handle("GET", "/webhooks/nope.json", q)[0] == 404
+    assert api.handle("POST", "/webhooks/nope.json", q, b"{}")[0] == 404
+    # bad payload
+    status, _ = api.handle("POST", "/webhooks/segmentio.json", q,
+                           json.dumps({"version": "2"}).encode())
+    assert status == 400
+
+
+def test_webhooks_mailchimp_form(api):
+    q = {"accessKey": "secret"}
+    form = {
+        "type": "subscribe", "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+        "data[ip_opt]": "10.20.10.30", "data[ip_signup]": "10.20.10.30",
+    }
+    body = urllib.parse.urlencode(form).encode()
+    status, out = api.handle("POST", "/webhooks/mailchimp.form", q, body)
+    assert status == 201
+    _, got = api.handle("GET", f"/events/{out['eventId']}.json", q)
+    assert got["event"] == "subscribe"
+    assert got["targetEntityId"] == "a6b5da1054"
+    assert got["eventTime"] == "2009-03-26T21:35:57.000Z"
+    assert api.handle("GET", "/webhooks/mailchimp.form", q)[0] == 200
+
+
+def test_plugins_describe_and_blocker(memory_storage):
+    class Blocker(EventServerPlugin):
+        plugin_name = "strict"
+        plugin_description = "rejects buy events"
+        plugin_type = INPUT_BLOCKER
+
+        def process(self, info, context):
+            if info.event.event == "buy":
+                raise ValueError("buy blocked")
+
+        def handle_rest(self, app_id, channel_id, args):
+            return json.dumps({"args": list(args)})
+
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "app4", None))
+    memory_storage.get_events().init(app_id)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("k4", app_id, ()))
+    api = EventAPI(storage=memory_storage,
+                   plugin_context=EventServerPluginContext([Blocker()]))
+    status, desc = api.handle("GET", "/plugins.json")
+    assert "strict" in desc["plugins"]["inputblockers"]
+    q = {"accessKey": "k4"}
+    status, _ = api.handle("POST", "/events.json", q, ev("buy"))
+    assert status == 500  # blocker raises -> exceptionHandler path
+    status, _ = api.handle("POST", "/events.json", q, ev("view"))
+    assert status == 201
+    status, body = api.handle("GET", "/plugins/inputblocker/strict/a/b", q)
+    assert (status, body) == (200, {"args": ["a", "b"]})
+
+
+def test_http_transport_smoke(api):
+    server, port = serve_background(api)
+    try:
+        base = f"http://localhost:{port}"
+        with urllib.request.urlopen(f"{base}/") as r:
+            assert json.loads(r.read()) == {"status": "alive"}
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey=secret", data=ev(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 201
+            assert "eventId" in json.loads(r.read())
+        # error statuses surface over the wire too
+        try:
+            urllib.request.urlopen(f"{base}/events.json")
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        server.shutdown()

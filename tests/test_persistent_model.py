@@ -1,0 +1,95 @@
+"""PersistentModel SPI roundtrip (ref: controller/PersistentModel.scala:67-115,
+LocalFileSystemPersistentModel.scala:39-77, Engine.makeSerializableModels
+:286-304, prepareDeploy :199-269)."""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm, DataSource, EngineParams, Engine, FirstServing,
+    LocalFileSystemPersistentModel, Params, Preparator,
+)
+from predictionio_tpu.controller.persistent_model import PersistentModelManifest
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+
+@dataclasses.dataclass
+class SelfSavingModel(LocalFileSystemPersistentModel):
+    weights: tuple = (1.0, 2.0)
+
+
+class _DS(DataSource):
+    def read_training(self, ctx):
+        return "td"
+
+
+class _Prep(Preparator):
+    def prepare(self, ctx, td):
+        return td
+
+
+@dataclasses.dataclass(frozen=True)
+class _AlgoParams(Params):
+    scale: float = 2.0
+
+
+class _Algo(Algorithm):
+    params_class = _AlgoParams
+
+    def __init__(self, params: _AlgoParams = _AlgoParams()):
+        self.params = params
+
+    def train(self, ctx, pd):
+        return SelfSavingModel(weights=(self.params.scale, 2.0))
+
+    def predict(self, model, query):
+        return {"w": list(model.weights)}
+
+
+def _engine():
+    return Engine(_DS, _Prep, {"algo": _Algo}, FirstServing)
+
+
+def test_persistent_model_train_deploy_roundtrip(memory_storage, tmp_path,
+                                                 monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    ctx = WorkflowContext(storage=memory_storage)
+    ep = EngineParams(
+        algorithm_params_list=(("algo", _AlgoParams(scale=7.0)),))
+    iid = run_train(ctx, _engine(), ep,
+                    engine_factory="tests.test_persistent_model:_engine",
+                    params_json={"algorithms": [
+                        {"name": "algo", "params": {"scale": 7.0}}]})
+    # the blob must hold a manifest, not the model
+    import pickle
+    blob = memory_storage.get_model_data_models().get(iid).models
+    stored = pickle.loads(blob)
+    assert isinstance(stored[0], PersistentModelManifest)
+    assert stored[0].module_name.endswith("test_persistent_model")
+
+    api = QueryAPI(storage=memory_storage, engine=_engine())
+    status, body = api.handle("POST", "/queries.json", body=b"{}")
+    assert status == 200 and body == {"w": [7.0, 2.0]}
+
+
+def test_unimportable_persistent_model_fails_at_save(memory_storage, tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+
+    class LocalModel(LocalFileSystemPersistentModel):  # <locals> qualname
+        pass
+
+    class BadAlgo(_Algo):
+        def train(self, ctx, pd):
+            return LocalModel()
+
+    engine = Engine(_DS, _Prep, {"algo": BadAlgo}, FirstServing)
+    ctx = WorkflowContext(storage=memory_storage)
+    ep = EngineParams(algorithm_params_list=(("algo", _AlgoParams()),))
+    with pytest.raises(ValueError, match="not importable"):
+        run_train(ctx, engine, ep, engine_factory="x")
+    # the failed run is recorded as ERROR, so deploy refuses it
+    rows = memory_storage.get_meta_data_engine_instances().get_all()
+    assert rows and all(r.status == "ERROR" for r in rows)
