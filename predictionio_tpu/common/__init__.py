@@ -1,0 +1,1 @@
+"""Cross-cutting helpers (ref: common/ — annotations, auth, SSL config)."""

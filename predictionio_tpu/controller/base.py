@@ -73,8 +73,11 @@ def create_doer(cls, params: Optional[Params]):
             obj = cls(params if params is not None else EmptyParams())
     else:
         obj = cls(params)
-    object.__setattr__(  # works for frozen-dataclass components too
-        obj, "_pio_params", params if params is not None else EmptyParams())
+    try:
+        object.__setattr__(  # works for frozen-dataclass components too
+            obj, "_pio_params", params if params is not None else EmptyParams())
+    except AttributeError:
+        pass  # __slots__ component: persistence hooks fall back to None
     return obj
 
 

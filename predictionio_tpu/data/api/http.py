@@ -25,11 +25,19 @@ class _Handler(BaseHTTPRequestHandler):
                                             keep_blank_values=True))
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
-        status, payload = self.api.handle(
-            method, parsed.path, query, body, dict(self.headers.items()))
-        data = json.dumps(payload).encode("utf-8")
+        try:
+            status, payload = self.api.handle(
+                method, parsed.path, query, body, dict(self.headers.items()))
+        except Exception as e:  # handler without its own guard
+            status, payload = 500, {"message": str(e)}
+        if isinstance(payload, str):  # pre-rendered HTML (dashboard pages)
+            data = payload.encode("utf-8")
+            ctype = "text/html; charset=UTF-8"
+        else:
+            data = json.dumps(payload).encode("utf-8")
+            ctype = "application/json; charset=UTF-8"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

@@ -10,8 +10,9 @@ style registration instead of java.util.ServiceLoader.
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional, Sequence
+from typing import Optional, Sequence
 
+from predictionio_tpu.common.plugin_registry import PluginContextBase
 from predictionio_tpu.data.event import Event
 
 logger = logging.getLogger("predictionio_tpu.api.plugins")
@@ -45,29 +46,16 @@ class EventServerPlugin:
         return "{}"
 
 
-class EventServerPluginContext:
+class EventServerPluginContext(PluginContextBase):
     """Plugin registry (EventServerPluginContext.scala:40-91)."""
 
-    def __init__(self, plugins: Sequence[EventServerPlugin] = ()):
-        self.input_blockers: Dict[str, EventServerPlugin] = {}
-        self.input_sniffers: Dict[str, EventServerPlugin] = {}
-        for p in plugins:
-            self.register(p)
+    BLOCKER_KIND = INPUT_BLOCKER
+    SNIFFER_KIND = INPUT_SNIFFER
 
-    def register(self, plugin: EventServerPlugin) -> None:
-        target = (self.input_blockers
-                  if plugin.plugin_type == INPUT_BLOCKER
-                  else self.input_sniffers)
-        target[plugin.plugin_name] = plugin
+    @property
+    def input_blockers(self):
+        return self.kind(INPUT_BLOCKER)
 
-    def describe(self) -> Dict[str, Dict[str, Dict[str, str]]]:
-        def block(ps: Dict[str, EventServerPlugin]):
-            return {
-                n: {"name": p.plugin_name,
-                    "description": p.plugin_description,
-                    "class": type(p).__module__ + "." + type(p).__qualname__}
-                for n, p in ps.items()}
-        return {"plugins": {
-            "inputblockers": block(self.input_blockers),
-            "inputsniffers": block(self.input_sniffers),
-        }}
+    @property
+    def input_sniffers(self):
+        return self.kind(INPUT_SNIFFER)

@@ -115,8 +115,9 @@ class EventAPI:
         # channel param, matching EventServer.scala:115-127)
         auth = headers.get("authorization") or headers.get("Authorization")
         if auth:
-            parts = auth.split("Basic ")
-            if len(parts) == 2:
+            parts = auth.strip().split(None, 1)
+            # auth-scheme is case-insensitive (RFC 7235 §2.1)
+            if len(parts) == 2 and parts[0].lower() == "basic":
                 try:
                     decoded = base64.b64decode(parts[1]).decode("utf-8")
                 except (binascii.Error, UnicodeDecodeError):
@@ -346,23 +347,13 @@ class EventAPI:
     # ------------------------------------------------------------- plugins
     def _plugins_rest(self, path: str, query: Dict[str, str],
                       headers: Dict[str, str]) -> Response:
+        from predictionio_tpu.common.plugin_registry import (
+            dispatch_plugin_rest,
+        )
         auth = self._authenticate(query, headers)
-        segments = [s for s in path.split("/") if s][1:]  # drop "plugins"
-        if len(segments) < 2:
-            return 404, {"message": "Not Found"}
-        plugin_type, plugin_name, *args = segments
-        registry = {
-            "inputblocker": self.plugin_context.input_blockers,
-            "inputsniffer": self.plugin_context.input_sniffers,
-        }.get(plugin_type)
-        if registry is None or plugin_name not in registry:
-            return 404, {"message": "Not Found"}
-        out = registry[plugin_name].handle_rest(
-            auth.app_id, auth.channel_id, args)
-        try:
-            return 200, json.loads(out)
-        except ValueError:
-            return 200, {"result": out}
+        return dispatch_plugin_rest(
+            self.plugin_context, path,
+            lambda p, args: p.handle_rest(auth.app_id, auth.channel_id, args))
 
 
 def _parse_bool(v: Optional[str]) -> bool:

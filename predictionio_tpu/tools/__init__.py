@@ -1,0 +1,8 @@
+"""`pio` CLI + admin tooling.
+
+Reference: tools/src/main/scala/org/apache/predictionio/tools/
+(console/Console.scala command surface; commands/{App,AccessKey,Engine,
+Import,Export,Management}.scala; dashboard/; admin/). The spark-submit
+process spawning (Runner.scala) collapses into in-process calls: one
+Python process per job is the whole runtime.
+"""

@@ -1,0 +1,490 @@
+"""The `pio` command-line console.
+
+Reference: tools/.../console/Console.scala:83-586 (command surface) and
+console/Pio.scala (implementations). Verbs:
+
+  version status build train eval deploy undeploy
+  eventserver dashboard adminserver run
+  app {new,list,show,delete,data-delete,channel-new,channel-delete}
+  accesskey {new,list,delete}
+  template {get,list}
+  import export
+
+spark-submit process spawning (Runner.scala:185-307) collapses to direct
+in-process calls: train/eval/deploy run in this interpreter against the
+TPU runtime.
+
+Run as: python -m predictionio_tpu.tools.cli <command> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+from predictionio_tpu import __version__
+from predictionio_tpu.data.storage import get_storage
+from predictionio_tpu.tools import apps as app_cmds
+from predictionio_tpu.tools.apps import CommandError
+
+logger = logging.getLogger("pio")
+
+
+def _info(msg: str) -> None:
+    print(f"[INFO] {msg}")
+
+
+def _error(msg: str) -> None:
+    print(f"[ERROR] {msg}", file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# engine workflow commands
+# ---------------------------------------------------------------------------
+
+def cmd_build(args) -> int:
+    """Validate the engine variant + factory import (the sbt compile step
+    collapses to an import check; commands/Engine.scala:65-161)."""
+    from predictionio_tpu.workflow.workflow_utils import (
+        get_engine, read_engine_variant,
+    )
+    engine_dir = os.path.abspath(args.engine_dir)
+    variant = read_engine_variant(engine_dir, args.variant)
+    engine = get_engine(variant["engineFactory"], base_dir=engine_dir)
+    engine.engine_params_from_json(variant)
+    _info(f"Engine {variant['engineFactory']} validated "
+          f"(variant {variant['id']}).")
+    _info("Build finished successfully. (Python engines need no compile.)")
+    return 0
+
+
+def _load_engine_and_params(args):
+    from predictionio_tpu.workflow.workflow_utils import (
+        get_engine, read_engine_variant,
+    )
+    engine_dir = os.path.abspath(args.engine_dir)
+    variant = read_engine_variant(engine_dir, args.variant)
+    engine = get_engine(variant["engineFactory"], base_dir=engine_dir)
+    engine_params = engine.engine_params_from_json(variant)
+    return engine_dir, variant, engine, engine_params
+
+
+def _make_context(batch: str = ""):
+    from predictionio_tpu.workflow import WorkflowContext, WorkflowParams
+    return WorkflowContext(workflow_params=WorkflowParams(batch=batch))
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.workflow import run_train
+    _engine_dir, variant, engine, engine_params = _load_engine_and_params(args)
+    ctx = _make_context(batch=args.batch)
+    instance_id = run_train(
+        ctx, engine, engine_params,
+        engine_id=variant.get("id", "default"),
+        engine_variant=variant.get("id", "default"),
+        engine_factory=variant["engineFactory"],
+        params_json=variant,
+    )
+    _info(f"Training completed. EngineInstance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.workflow import run_evaluation
+    from predictionio_tpu.workflow.workflow_utils import (
+        get_engine_params_generator, get_evaluation,
+    )
+    engine_dir = os.path.abspath(args.engine_dir)
+    evaluation = get_evaluation(args.evaluation_class, base_dir=engine_dir)
+    if args.engine_params_generator_class:
+        generator = get_engine_params_generator(
+            args.engine_params_generator_class, base_dir=engine_dir)
+        params_list = generator.engine_params_list
+    else:
+        generator = evaluation  # Evaluation may carry its own list
+        params_list = getattr(evaluation, "engine_params_list", None)
+        if params_list is None:
+            _error("No EngineParamsGenerator given and the Evaluation "
+                   "defines no engine_params_list.")
+            return 1
+    ctx = _make_context(batch=args.batch)
+    result = run_evaluation(
+        ctx, evaluation, params_list,
+        evaluation_class=args.evaluation_class,
+        generator_class=args.engine_params_generator_class or "",
+        output_path=args.output_best_engine_params or "best.json",
+    )
+    print(str(result))
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.workflow.create_server import (
+        QueryAPI, ServerConfig, serve, undeploy,
+    )
+    from predictionio_tpu.workflow.workflow_utils import read_engine_variant
+    variant = read_engine_variant(os.path.abspath(args.engine_dir),
+                                  args.variant)
+    config = ServerConfig(
+        engine_instance_id=args.engine_instance_id,
+        engine_dir=os.path.abspath(args.engine_dir),
+        engine_id=variant.get("id", "default"),
+        engine_variant=variant.get("id", "default"),
+        ip=args.ip, port=args.port,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        access_key=args.accesskey,
+    )
+    # undeploy a previous server on the same port (CreateServer.scala:260-294)
+    if undeploy(args.ip, args.port):
+        _info(f"Undeployed previous server at {args.ip}:{args.port}.")
+    api = QueryAPI(config=config)
+    _info(f"Engine is deployed and running. Engine API is live at "
+          f"http://{args.ip}:{args.port}.")
+    serve(api, host=args.ip, port=args.port)
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    from predictionio_tpu.workflow.create_server import undeploy
+    if undeploy(args.ip, args.port):
+        _info(f"Undeployed server at {args.ip}:{args.port}.")
+        return 0
+    _error(f"Undeploy failed: nothing listening at {args.ip}:{args.port}.")
+    return 1
+
+
+def cmd_run(args) -> int:
+    """Run an arbitrary main class (console run, Console.scala:367-389)."""
+    from predictionio_tpu.workflow.workflow_utils import load_object
+    target = load_object(args.main_class,
+                         base_dir=os.path.abspath(args.engine_dir))
+    rv = target(*args.args) if callable(target) else None
+    return int(rv or 0)
+
+
+# ---------------------------------------------------------------------------
+# daemons
+# ---------------------------------------------------------------------------
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api import EventAPI, EventServerConfig
+    from predictionio_tpu.data.api.http import serve_forever
+    api = EventAPI(config=EventServerConfig(
+        ip=args.ip, port=args.port, stats=args.stats))
+    _info(f"Event Server is started at {args.ip}:{args.port}.")
+    serve_forever(api, host=args.ip, port=args.port)
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.data.api.http import serve_forever
+    from predictionio_tpu.tools.dashboard import DashboardAPI
+    _info(f"Dashboard is started at {args.ip}:{args.port}.")
+    serve_forever(DashboardAPI(), host=args.ip, port=args.port)
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.data.api.http import serve_forever
+    from predictionio_tpu.tools.admin import AdminAPI
+    _info(f"Admin server is started at {args.ip}:{args.port}.")
+    serve_forever(AdminAPI(), host=args.ip, port=args.port)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# status / app / accesskey / template / import / export
+# ---------------------------------------------------------------------------
+
+def cmd_status(args) -> int:
+    """Verify installation + storage (commands/Management.scala:181,
+    Storage.verifyAllDataObjects)."""
+    _info(f"PredictionIO-TPU {__version__}")
+    import jax
+    _info(f"JAX {jax.__version__}; devices: "
+          f"{[str(d) for d in jax.devices()]}")
+    storage = get_storage()
+    _info("Verifying configured storage backend(s)...")
+    try:
+        storage.verify_all_data_objects()
+    except Exception as e:
+        _error(f"Unable to connect to all storage backends: {e}")
+        return 1
+    _info("(sleeping 5 seconds for all messages to show up...)")
+    _info("Your system is all ready to go.")
+    return 0
+
+
+def cmd_app(args) -> int:
+    storage = get_storage()
+    if args.app_command == "new":
+        d = app_cmds.create(args.name, app_id=args.id,
+                            description=args.description,
+                            access_key=args.access_key or "",
+                            storage=storage)
+        _info(f"Initialized Event Store for this app ID: {d.app.id}.")
+        _info("Created a new app:")
+        _info(f"      Name: {d.app.name}")
+        _info(f"        ID: {d.app.id}")
+        _info(f"Access Key: {d.keys[0].key}")
+    elif args.app_command == "list":
+        _info(f"{'Name':20} | {'ID':4} | Access Key | Allowed Event(s)")
+        for d in app_cmds.list_apps(storage):
+            for k in d.keys:
+                allowed = ",".join(k.events) if k.events else "(all)"
+                _info(f"{d.app.name:20} | {d.app.id:4} | {k.key} | {allowed}")
+        _info(f"Finished listing {len(app_cmds.list_apps(storage))} app(s).")
+    elif args.app_command == "show":
+        d, channels = app_cmds.show(args.name, storage=storage)
+        _info(f"    App Name: {d.app.name}")
+        _info(f"      App ID: {d.app.id}")
+        _info(f" Description: {d.app.description or ''}")
+        for k in d.keys:
+            allowed = ",".join(k.events) if k.events else "(all)"
+            _info(f"  Access Key: {k.key} | {allowed}")
+        for c in channels:
+            _info(f"     Channel: {c.name} (ID {c.id})")
+    elif args.app_command == "delete":
+        if not args.force and not _confirm(
+                f"Delete app {args.name} and ALL of its data?"):
+            return 1
+        app_cmds.delete(args.name, storage=storage)
+        _info(f"App {args.name} deleted.")
+    elif args.app_command == "data-delete":
+        if not args.force and not _confirm(
+                f"Delete data of app {args.name}?"):
+            return 1
+        app_cmds.data_delete(args.name, channel=args.channel,
+                             delete_all=args.all, storage=storage)
+        _info(f"Data of app {args.name} deleted.")
+    elif args.app_command == "channel-new":
+        c = app_cmds.channel_new(args.name, args.channel, storage=storage)
+        _info(f"Channel {c.name} (ID {c.id}) created for app {args.name}.")
+    elif args.app_command == "channel-delete":
+        if not args.force and not _confirm(
+                f"Delete channel {args.channel} of app {args.name}?"):
+            return 1
+        app_cmds.channel_delete(args.name, args.channel, storage=storage)
+        _info(f"Channel {args.channel} deleted.")
+    return 0
+
+
+def cmd_accesskey(args) -> int:
+    storage = get_storage()
+    if args.accesskey_command == "new":
+        k = app_cmds.accesskey_new(args.app_name, key=args.key or "",
+                                   events=args.event or (), storage=storage)
+        _info(f"Created new access key: {k.key}")
+    elif args.accesskey_command == "list":
+        for k in app_cmds.accesskey_list(args.app_name, storage=storage):
+            allowed = ",".join(k.events) if k.events else "(all)"
+            _info(f"{k.key} | app {k.appid} | {allowed}")
+    elif args.accesskey_command == "delete":
+        app_cmds.accesskey_delete(args.key, storage=storage)
+        _info(f"Deleted access key {args.key}.")
+    return 0
+
+
+def cmd_template(args) -> int:
+    """Template gallery moved to the web in the reference too
+    (Console.scala:546-560)."""
+    _info("Engine templates ship inside predictionio_tpu.models.*:")
+    _info("  recommendation    - ALS matrix factorization (MovieLens-style)")
+    _info("  classification    - Naive Bayes over $set user properties")
+    _info("  similarproduct    - implicit ALS item-vector similarity")
+    _info("  ecommerce         - implicit ALS + business-rule filters")
+    _info("Instantiate one by pointing engine.json's engineFactory at its "
+          "factory, e.g. predictionio_tpu.models.recommendation:"
+          "RecommendationEngine.")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.tools.transfer import file_to_events
+    n = file_to_events(args.input, args.appid, channel=args.channel)
+    _info(f"Imported {n} events.")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.tools.transfer import events_to_file
+    n = events_to_file(args.output, args.appid, channel=args.channel)
+    _info(f"Exported {n} events.")
+    return 0
+
+
+def _confirm(prompt: str) -> bool:
+    answer = input(f"{prompt} (Y/n) ")
+    return answer.strip().lower() in ("", "y", "yes")
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio",
+        description="PredictionIO-TPU command-line console")
+    p.add_argument("--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="show version")
+    sub.add_parser("status", help="verify installation and storage")
+
+    def engine_flags(sp):
+        sp.add_argument("--engine-dir", default=".",
+                        help="engine directory (default: cwd)")
+        sp.add_argument("--variant", default="engine.json",
+                        help="engine variant JSON (default: engine.json)")
+
+    sp = sub.add_parser("build", help="validate an engine")
+    engine_flags(sp)
+
+    sp = sub.add_parser("train", help="train an engine instance")
+    engine_flags(sp)
+    sp.add_argument("--batch", default="", help="batch label")
+
+    sp = sub.add_parser("eval", help="run an evaluation")
+    sp.add_argument("evaluation_class")
+    sp.add_argument("engine_params_generator_class", nargs="?", default="")
+    sp.add_argument("--engine-dir", default=".")
+    sp.add_argument("--batch", default="")
+    sp.add_argument("--output-best-engine-params", default="",
+                    help="where to write best.json")
+
+    sp = sub.add_parser("deploy", help="deploy the latest engine instance")
+    engine_flags(sp)
+    sp.add_argument("--engine-instance-id", default=None)
+    sp.add_argument("--ip", default="localhost")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--feedback", action="store_true")
+    sp.add_argument("--event-server-ip", default="localhost")
+    sp.add_argument("--event-server-port", type=int, default=7070)
+    sp.add_argument("--accesskey", default=None)
+
+    sp = sub.add_parser("undeploy", help="stop a deployed engine server")
+    sp.add_argument("--ip", default="localhost")
+    sp.add_argument("--port", type=int, default=8000)
+
+    sp = sub.add_parser("run", help="run an arbitrary entry point")
+    sp.add_argument("main_class")
+    sp.add_argument("args", nargs="*")
+    sp.add_argument("--engine-dir", default=".")
+
+    sp = sub.add_parser("eventserver", help="start the event server")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=7070)
+    sp.add_argument("--stats", action="store_true")
+
+    sp = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=9000)
+
+    sp = sub.add_parser("adminserver", help="start the admin API server")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=7071)
+
+    sp = sub.add_parser("app", help="manage apps")
+    asub = sp.add_subparsers(dest="app_command", required=True)
+    a = asub.add_parser("new")
+    a.add_argument("name")
+    a.add_argument("--id", type=int, default=None)
+    a.add_argument("--description", default=None)
+    a.add_argument("--access-key", default=None)
+    asub.add_parser("list")
+    a = asub.add_parser("show")
+    a.add_argument("name")
+    a = asub.add_parser("delete")
+    a.add_argument("name")
+    a.add_argument("-f", "--force", action="store_true")
+    a = asub.add_parser("data-delete")
+    a.add_argument("name")
+    a.add_argument("--channel", default=None)
+    a.add_argument("--all", action="store_true")
+    a.add_argument("-f", "--force", action="store_true")
+    a = asub.add_parser("channel-new")
+    a.add_argument("name")
+    a.add_argument("channel")
+    a = asub.add_parser("channel-delete")
+    a.add_argument("name")
+    a.add_argument("channel")
+    a.add_argument("-f", "--force", action="store_true")
+
+    sp = sub.add_parser("accesskey", help="manage access keys")
+    ksub = sp.add_subparsers(dest="accesskey_command", required=True)
+    k = ksub.add_parser("new")
+    k.add_argument("app_name")
+    k.add_argument("--key", default=None)
+    k.add_argument("--event", action="append", default=None,
+                   help="restrict to this event name (repeatable)")
+    k = ksub.add_parser("list")
+    k.add_argument("app_name", nargs="?", default=None)
+    k = ksub.add_parser("delete")
+    k.add_argument("key")
+
+    sp = sub.add_parser("template", help="engine template info")
+    tsub = sp.add_subparsers(dest="template_command")
+    tsub.add_parser("list")
+    t = tsub.add_parser("get")
+    t.add_argument("name", nargs="?")
+
+    sp = sub.add_parser("import", help="import events from a JSON-lines file")
+    sp.add_argument("--appid", type=int, required=True)
+    sp.add_argument("--channel", default=None)
+    sp.add_argument("--input", required=True)
+
+    sp = sub.add_parser("export", help="export events to a JSON-lines file")
+    sp.add_argument("--appid", type=int, required=True)
+    sp.add_argument("--channel", default=None)
+    sp.add_argument("--output", required=True)
+
+    return p
+
+
+_DISPATCH = {
+    "build": cmd_build,
+    "train": cmd_train,
+    "eval": cmd_eval,
+    "deploy": cmd_deploy,
+    "undeploy": cmd_undeploy,
+    "run": cmd_run,
+    "eventserver": cmd_eventserver,
+    "dashboard": cmd_dashboard,
+    "adminserver": cmd_adminserver,
+    "status": cmd_status,
+    "app": cmd_app,
+    "accesskey": cmd_accesskey,
+    "template": cmd_template,
+    "import": cmd_import,
+    "export": cmd_export,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.verbose:
+        logging.basicConfig(level=logging.DEBUG)
+    else:
+        logging.basicConfig(level=logging.INFO)
+    if args.command is None or args.command == "version":
+        print(__version__)
+        return 0
+    try:
+        return _DISPATCH[args.command](args)
+    except CommandError as e:
+        _error(str(e))
+        return 1
+    except FileNotFoundError as e:
+        _error(str(e))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

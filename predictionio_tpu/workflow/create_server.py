@@ -104,11 +104,13 @@ def engine_params_from_instance(engine: Engine, instance) -> EngineParams:
 
 
 def prepare_deploy(ctx, engine: Engine, engine_params: EngineParams,
-                   instance_id: str, models: List[Any]) -> List[Any]:
+                   instance_id: str, models: List[Any],
+                   algorithms: Optional[List[Any]] = None) -> List[Any]:
     """Make persisted models servable (Engine.prepareDeploy,
     Engine.scala:199-269): manifest -> user loader; None -> retrain;
     otherwise device_put the blob's arrays back into HBM."""
-    _, _, algorithms, _ = engine._instantiate(engine_params)
+    if algorithms is None:
+        _, _, algorithms, _ = engine._instantiate(engine_params)
     out = []
     retrained: Optional[List[Any]] = None
     for i, (algo, model) in enumerate(zip(algorithms, models)):
@@ -160,9 +162,10 @@ class QueryAPI:
         if blob is None:
             raise ValueError(f"No model data for EngineInstance {instance.id}")
         models = model_io.deserialize_models(blob.models)
-        models = prepare_deploy(
-            self.ctx, engine, engine_params, instance.id, models)
         _, _, algorithms, serving = engine._instantiate(engine_params)
+        models = prepare_deploy(
+            self.ctx, engine, engine_params, instance.id, models,
+            algorithms=algorithms)
         with self._lock:
             self.engine_instance = instance
             self.engine = engine
@@ -257,11 +260,12 @@ class QueryAPI:
                 self.plugin_context)
 
         dt = time.perf_counter() - t0
-        self.last_serving_sec = dt
-        self.avg_serving_sec = (
-            (self.avg_serving_sec * self.request_count) + dt
-        ) / (self.request_count + 1)
-        self.request_count += 1
+        with self._lock:  # ThreadingHTTPServer: concurrent queries
+            self.last_serving_sec = dt
+            self.avg_serving_sec = (
+                (self.avg_serving_sec * self.request_count) + dt
+            ) / (self.request_count + 1)
+            self.request_count += 1
         return 200, result
 
     def _feedback(self, instance, query, prediction, result,
@@ -309,21 +313,12 @@ class QueryAPI:
         return result
 
     def _plugins_rest(self, path: str) -> Response:
-        segments = [s for s in path.split("/") if s][1:]
-        if len(segments) < 2:
-            return 404, {"message": "Not Found"}
-        plugin_type, plugin_name, *args = segments
-        registry = {
-            "outputblocker": self.plugin_context.output_blockers,
-            "outputsniffer": self.plugin_context.output_sniffers,
-        }.get(plugin_type)
-        if registry is None or plugin_name not in registry:
-            return 404, {"message": "Not Found"}
-        out = registry[plugin_name].handle_rest(args)
-        try:
-            return 200, json.loads(out)
-        except ValueError:
-            return 200, {"result": out}
+        from predictionio_tpu.common.plugin_registry import (
+            dispatch_plugin_rest,
+        )
+        return dispatch_plugin_rest(
+            self.plugin_context, path,
+            lambda p, args: p.handle_rest(args))
 
 
 def undeploy(ip: str, port: int) -> bool:

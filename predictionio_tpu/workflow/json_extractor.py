@@ -11,19 +11,24 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import types
 import typing
 from typing import Any, Dict, Optional, Type
 
 
 def extract(cls: Optional[Type], obj: Any):
     """JSON value -> instance of cls (recursively over dataclass fields)."""
-    if cls is None or obj is None:
+    if cls is None or cls is Any:
         return obj
     origin = typing.get_origin(cls)
-    if origin is typing.Union:  # Optional[T] and unions
-        args = [a for a in typing.get_args(cls) if a is not type(None)]
-        if obj is None:
+    is_union = origin is typing.Union or origin is types.UnionType
+    if obj is None:
+        if cls is type(None) or (
+                is_union and type(None) in typing.get_args(cls)):
             return None
+        raise ValueError(f"null is not allowed for {cls}")
+    if is_union:  # Optional[T] and unions, both typing.Union and X | Y
+        args = [a for a in typing.get_args(cls) if a is not type(None)]
         last_err = None
         for a in args:
             try:
@@ -69,12 +74,12 @@ def extract(cls: Optional[Type], obj: Any):
                 raise ValueError(
                     f"field {name} is required for {cls.__name__}")
         return cls(**kwargs)
+    # bool is an int subclass; reject bool-for-int/float confusions
+    if cls in (int, float) and isinstance(obj, bool):
+        raise ValueError(f"expected {cls.__name__}, got {obj!r}")
     if cls is float and isinstance(obj, int):
         return float(obj)
     if isinstance(cls, type) and not isinstance(obj, cls):
-        # bool is an int subclass; reject bool-for-int confusions both ways
-        if cls is int and isinstance(obj, bool):
-            raise ValueError(f"expected int, got {obj!r}")
         raise ValueError(f"expected {cls.__name__}, got {obj!r}")
     return obj
 

@@ -8,7 +8,9 @@ asynchronously and can answer REST calls under /plugins/.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from typing import Sequence
+
+from predictionio_tpu.common.plugin_registry import PluginContextBase
 
 OUTPUT_BLOCKER = "outputblocker"
 OUTPUT_SNIFFER = "outputsniffer"
@@ -31,27 +33,14 @@ class EngineServerPlugin:
         """Called once when the server starts (EngineServerPlugin.start)."""
 
 
-class EngineServerPluginContext:
-    def __init__(self, plugins: Sequence[EngineServerPlugin] = ()):
-        self.output_blockers: Dict[str, EngineServerPlugin] = {}
-        self.output_sniffers: Dict[str, EngineServerPlugin] = {}
-        for p in plugins:
-            self.register(p)
+class EngineServerPluginContext(PluginContextBase):
+    BLOCKER_KIND = OUTPUT_BLOCKER
+    SNIFFER_KIND = OUTPUT_SNIFFER
 
-    def register(self, plugin: EngineServerPlugin) -> None:
-        target = (self.output_blockers
-                  if plugin.plugin_type == OUTPUT_BLOCKER
-                  else self.output_sniffers)
-        target[plugin.plugin_name] = plugin
+    @property
+    def output_blockers(self):
+        return self.kind(OUTPUT_BLOCKER)
 
-    def describe(self) -> Dict[str, Dict[str, Dict[str, str]]]:
-        def block(ps):
-            return {
-                n: {"name": p.plugin_name,
-                    "description": p.plugin_description,
-                    "class": type(p).__module__ + "." + type(p).__qualname__}
-                for n, p in ps.items()}
-        return {"plugins": {
-            "outputblockers": block(self.output_blockers),
-            "outputsniffers": block(self.output_sniffers),
-        }}
+    @property
+    def output_sniffers(self):
+        return self.kind(OUTPUT_SNIFFER)

@@ -1,0 +1,192 @@
+"""CLI + dashboard + admin tests, ending with the quickstart lifecycle
+(ref: tests/pio_tests/scenarios/{quickstart_test,basic_app_usecases}.py and
+tools/.../console/Console.scala)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api import EventAPI
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.tools import apps as app_cmds
+from predictionio_tpu.tools.admin import AdminAPI
+from predictionio_tpu.tools.cli import main
+from predictionio_tpu.tools.dashboard import DashboardAPI
+from predictionio_tpu.tools.transfer import events_to_file, file_to_events
+
+
+def test_version_and_template(capsys, memory_storage):
+    assert main(["version"]) == 0
+    assert main(["template", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "recommendation" in out
+
+
+def test_app_lifecycle(capsys, memory_storage):
+    assert main(["app", "new", "CliApp", "--access-key", "ck"]) == 0
+    out = capsys.readouterr().out
+    assert "Access Key: ck" in out
+    # duplicate fails
+    assert main(["app", "new", "CliApp"]) == 1
+    assert "already exists" in capsys.readouterr().err
+    assert main(["app", "list"]) == 0
+    assert "CliApp" in capsys.readouterr().out
+    assert main(["app", "channel-new", "CliApp", "mobile"]) == 0
+    assert main(["app", "show", "CliApp"]) == 0
+    out = capsys.readouterr().out
+    assert "mobile" in out
+    assert main(["app", "channel-delete", "CliApp", "mobile", "-f"]) == 0
+    assert main(["accesskey", "new", "CliApp", "--event", "view"]) == 0
+    keys = app_cmds.accesskey_list("CliApp", storage=memory_storage)
+    assert {k.events for k in keys} >= {(), ("view",)}
+    extra = [k for k in keys if k.events == ("view",)][0]
+    assert main(["accesskey", "delete", extra.key]) == 0
+    assert main(["app", "data-delete", "CliApp", "-f"]) == 0
+    assert main(["app", "delete", "CliApp", "-f"]) == 0
+    assert app_cmds.list_apps(storage=memory_storage) == []
+
+
+def test_import_export_roundtrip(tmp_path, memory_storage):
+    d = app_cmds.create("IoApp", storage=memory_storage)
+    src = tmp_path / "events.jsonl"
+    lines = [
+        {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": float(i)},
+         "eventTime": f"2021-01-01T00:{i:02d}:00.000Z"}
+        for i in range(5)]
+    src.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+    n = file_to_events(str(src), d.app.id, storage=memory_storage)
+    assert n == 5
+    dst = tmp_path / "out.jsonl"
+    n = events_to_file(str(dst), d.app.id, storage=memory_storage)
+    assert n == 5
+    back = [json.loads(l) for l in dst.read_text().splitlines()]
+    assert {e["entityId"] for e in back} == {f"u{i}" for i in range(5)}
+    # malformed line errors with location
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"event": "x"}\n')
+    with pytest.raises(app_cmds.CommandError, match="bad.jsonl:1"):
+        file_to_events(str(bad), d.app.id, storage=memory_storage)
+
+
+def test_admin_api(memory_storage):
+    api = AdminAPI(storage=memory_storage)
+    assert api.handle("GET", "/")[0] == 200
+    status, body = api.handle("POST", "/cmd/app",
+                              body=json.dumps({"name": "AdminApp"}).encode())
+    assert status == 201 and body["name"] == "AdminApp"
+    assert len(body["accessKeys"]) == 1
+    status, listing = api.handle("GET", "/cmd/app")
+    assert status == 200 and listing[0]["name"] == "AdminApp"
+    # duplicate -> 400
+    status, _ = api.handle("POST", "/cmd/app",
+                           body=json.dumps({"name": "AdminApp"}).encode())
+    assert status == 400
+    assert api.handle("DELETE", "/cmd/app/AdminApp/data")[0] == 200
+    assert api.handle("DELETE", "/cmd/app/AdminApp")[0] == 200
+    assert api.handle("GET", "/cmd/app")[1] == []
+
+
+def test_dashboard_lists_completed_evaluations(memory_storage):
+    from predictionio_tpu.data.storage import EvaluationInstance
+    import datetime as dt
+    now = dt.datetime.now(dt.timezone.utc)
+    instances = memory_storage.get_meta_data_evaluation_instances()
+    iid = instances.insert(EvaluationInstance(
+        id="", status="EVALCOMPLETED", start_time=now, end_time=now,
+        evaluation_class="my.Evaluation",
+        evaluator_results_html="<p>score 0.5</p>",
+        evaluator_results_json='{"bestIdx": 0}'))
+    instances.insert(EvaluationInstance(
+        id="", status="INIT", start_time=now, end_time=now,
+        evaluation_class="pending.Eval"))
+    api = DashboardAPI(storage=memory_storage)
+    status, page = api.handle("GET", "/")
+    assert status == 200 and "my.Evaluation" in page
+    assert "pending.Eval" not in page
+    status, body = api.handle("GET", f"/engine_instances/{iid}.json")
+    assert status == 200 and body == {"bestIdx": 0}
+    status, page = api.handle("GET", f"/engine_instances/{iid}.html")
+    assert status == 200 and "score 0.5" in page
+    assert api.handle("GET", "/engine_instances/zzz.json")[0] == 404
+
+
+def test_quickstart_lifecycle(tmp_path, capsys, memory_storage, monkeypatch):
+    """pio app new -> events via REST -> pio train -> deploy -> query
+    (quickstart_test.py:50-140)."""
+    assert main(["app", "new", "MyApp1", "--access-key", "qs"]) == 0
+    capsys.readouterr()
+
+    # ingest ratings through a live event server
+    es, es_port = serve_background(EventAPI(storage=memory_storage))
+    try:
+        batch = []
+        for u in range(8):
+            for i in range(6):
+                batch.append({
+                    "event": "rate", "entityType": "user",
+                    "entityId": f"u{u}",
+                    "targetEntityType": "item", "targetEntityId": f"i{i}",
+                    "properties": {
+                        "rating": 5.0 if (u % 2) == (i % 2) else 1.0}})
+        for off in range(0, len(batch), 50):
+            req = urllib.request.Request(
+                f"http://localhost:{es_port}/batch/events.json?accessKey=qs",
+                data=json.dumps(batch[off:off + 50]).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as r:
+                assert all(x["status"] == 201 for x in json.loads(r.read()))
+    finally:
+        es.shutdown()
+
+    # engine directory with engine.json (template parity: engine.json:14-17)
+    engine_dir = tmp_path / "rec-engine"
+    engine_dir.mkdir()
+    (engine_dir / "engine.json").write_text(json.dumps({
+        "id": "default",
+        "description": "Default settings",
+        "engineFactory":
+            "predictionio_tpu.models.recommendation:RecommendationEngine",
+        "datasource": {"params": {"appName": "MyApp1"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": 5, "lambda": 0.05, "seed": 3}}],
+    }))
+    assert main(["build", "--engine-dir", str(engine_dir)]) == 0
+    assert main(["train", "--engine-dir", str(engine_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "Training completed" in out
+
+    # deploy on an ephemeral port in a thread; query; undeploy stops it
+    from predictionio_tpu.workflow.create_server import QueryAPI, serve
+    api = QueryAPI()
+    port_holder = {}
+
+    def run():
+        from predictionio_tpu.data.api.http import serve_background as sb
+        server, port = sb(api)
+        port_holder["port"] = port
+        while not api.stop_requested:
+            time.sleep(0.05)
+        server.shutdown()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    for _ in range(100):
+        if "port" in port_holder:
+            break
+        time.sleep(0.05)
+    port = port_holder["port"]
+    req = urllib.request.Request(
+        f"http://localhost:{port}/queries.json",
+        data=json.dumps({"user": "u1", "num": 4}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        body = json.loads(r.read())
+    assert len(body["itemScores"]) == 4  # quickstart_test.py:95-100
+    assert main(["undeploy", "--port", str(port)]) == 0
+    t.join(timeout=5)
+    assert not t.is_alive()

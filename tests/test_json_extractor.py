@@ -1,0 +1,77 @@
+"""Typed JSON codec tests (ref: core/src/test/scala/.../JsonExtractorSuite)."""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import pytest
+
+from predictionio_tpu.workflow.json_extractor import (
+    extract, extract_query, to_json_obj,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    name: str
+    weight: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Q:
+    user: str
+    num: int
+    items: Optional[Tuple[str, ...]] = None
+    inner: Optional[Inner] = None
+
+
+def test_extract_nested_and_defaults():
+    q = extract(Q, {"user": "u1", "num": 3,
+                    "items": ["a", "b"],
+                    "inner": {"name": "x"}})
+    assert q == Q("u1", 3, ("a", "b"), Inner("x", 1.0))
+    # int widening to float
+    assert extract(Inner, {"name": "x", "weight": 2}).weight == 2.0
+
+
+def test_extract_rejects_bad_input():
+    with pytest.raises(ValueError, match="required"):
+        extract(Q, {"user": "u1"})
+    with pytest.raises(ValueError, match="unknown field"):
+        extract(Q, {"user": "u1", "num": 1, "zzz": 2})
+    with pytest.raises(ValueError, match="expected int"):
+        extract(Q, {"user": "u1", "num": "3"})
+    with pytest.raises(ValueError, match="expected int"):
+        extract(Q, {"user": "u1", "num": True})
+    # null for a required non-Optional field is rejected
+    with pytest.raises(ValueError, match="null"):
+        extract(Q, {"user": None, "num": 3})
+    # null for Optional passes
+    assert extract(Q, {"user": "u", "num": 1, "items": None}).items is None
+
+
+def test_extract_pep604_union():
+    @dataclasses.dataclass(frozen=True)
+    class Modern:
+        name: str
+        inner: Inner | None = None
+        count: int | str = 0
+
+    m = extract(Modern, {"name": "a", "inner": {"name": "i"}})
+    assert m.inner == Inner("i")  # validated, not a raw dict
+    with pytest.raises(ValueError):
+        extract(Modern, {"name": "a", "inner": {"nope": 1}})
+    assert extract(Modern, {"name": "a", "count": "x"}).count == "x"
+    with pytest.raises(ValueError, match="null"):
+        extract(Modern, {"name": None})
+
+
+def test_to_json_obj_drops_none_fields():
+    assert to_json_obj(Q("u", 2)) == {"user": "u", "num": 2}
+    assert to_json_obj(Q("u", 2, ("i",), Inner("x"))) == {
+        "user": "u", "num": 2, "items": ["i"],
+        "inner": {"name": "x", "weight": 1.0}}
+
+
+def test_extract_query_bytes():
+    assert extract_query(Q, b'{"user": "u", "num": 1}') == Q("u", 1)
+    assert extract_query(None, b'{"free": 1}') == {"free": 1}
