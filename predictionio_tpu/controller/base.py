@@ -144,6 +144,14 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
                 return manifest
         return model
 
+    def bind_serving(self, ctx) -> None:
+        """Called with the active WorkflowContext before this algorithm's
+        predict/batch_predict is used (deploy load, reload, eval).
+        Algorithms doing live event-store lookups at predict time (the
+        e-commerce template's seen/unavailable filters) capture
+        ctx.storage here instead of relying on the process-global
+        singleton."""
+
     @property
     def query_class(self):
         """Optional override: the Query dataclass for JSON extraction."""

@@ -160,6 +160,8 @@ class Engine:
         params = ctx.workflow_params
         eval_sets = data_source.read_eval(ctx)
         out = []
+        for a in algorithms:
+            a.bind_serving(ctx)
         for td, ei, qa_list in eval_sets:
             self._sanity_check(td, params)
             pd = preparator.prepare(ctx, td)

@@ -1,0 +1,12 @@
+"""Reusable algorithm library (ref: e2/src/main/scala/.../e2/)."""
+
+from predictionio_tpu.e2.engine import (
+    BinaryVectorizer, CategoricalNaiveBayes, CategoricalNaiveBayesModel,
+    LabeledPoint, MarkovChain, MarkovChainModel,
+)
+from predictionio_tpu.e2.evaluation import split_data
+
+__all__ = [
+    "BinaryVectorizer", "CategoricalNaiveBayes", "CategoricalNaiveBayesModel",
+    "LabeledPoint", "MarkovChain", "MarkovChainModel", "split_data",
+]

@@ -1,0 +1,23 @@
+"""Classification engine template (Naive Bayes over $set user properties).
+
+Reference: examples/scala-parallel-classification/add-algorithm/src/main/
+scala/ — DataSource reads `plan, attr0, attr1, attr2` from aggregated user
+properties; NaiveBayesAlgorithm wraps the multinomial NB kernel; Query is
+a dense feature vector, PredictedResult a label.
+"""
+
+from predictionio_tpu.models.classification.engine import (
+    ClassificationEngine, PredictedResult, Query,
+)
+from predictionio_tpu.models.classification.data_source import (
+    DataSource, DataSourceParams, TrainingData,
+)
+from predictionio_tpu.models.classification.nb_algorithm import (
+    NaiveBayesAlgorithm, NaiveBayesAlgorithmParams,
+)
+
+__all__ = [
+    "ClassificationEngine", "PredictedResult", "Query",
+    "DataSource", "DataSourceParams", "TrainingData",
+    "NaiveBayesAlgorithm", "NaiveBayesAlgorithmParams",
+]

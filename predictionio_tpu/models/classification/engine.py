@@ -1,0 +1,41 @@
+"""Query/result types + engine factory.
+
+Parity: scala-parallel-classification/add-algorithm/src/main/scala/
+Engine.scala (Query = features array, PredictedResult = label,
+ClassificationEngine factory).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Query:
+    features: Tuple[float, ...]
+
+    def __post_init__(self):
+        if not isinstance(self.features, tuple):
+            object.__setattr__(self, "features", tuple(self.features))
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    label: float
+
+
+def ClassificationEngine():
+    """Engine factory (Engine.scala object ClassificationEngine)."""
+    from predictionio_tpu.controller import Engine, FirstServing, IdentityPreparator
+    from predictionio_tpu.models.classification.data_source import DataSource
+    from predictionio_tpu.models.classification.nb_algorithm import (
+        NaiveBayesAlgorithm,
+    )
+
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"naive": NaiveBayesAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -1,0 +1,71 @@
+"""NaiveBayesAlgorithm: multinomial NB on TPU.
+
+Parity: scala-parallel-classification/add-algorithm/src/main/scala/
+NaiveBayesAlgorithm.scala:28-45 — MLlib NaiveBayes.train(lambda) becomes
+ops.naive_bayes.train; labels are arbitrary floats (plan ids), encoded
+to class indices around the kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import Algorithm, Params
+from predictionio_tpu.models.classification.data_source import TrainingData
+from predictionio_tpu.models.classification.engine import (
+    PredictedResult, Query,
+)
+from predictionio_tpu.ops import naive_bayes
+
+
+@dataclass(frozen=True)
+class NaiveBayesAlgorithmParams(Params):
+    """engine.json key `lambda` (NaiveBayesAlgorithm.scala:30-32)."""
+    lambda_: float = 1.0
+
+    JSON_ALIASES = {"lambda": "lambda_"}
+
+
+@dataclass
+class ClassificationModel:
+    nb: naive_bayes.NaiveBayesModel
+    class_labels: Tuple[float, ...]   # class index -> original label
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    params_class = NaiveBayesAlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: NaiveBayesAlgorithmParams =
+                 NaiveBayesAlgorithmParams()):
+        self.ap = params
+
+    def train(self, ctx, data: TrainingData) -> ClassificationModel:
+        labels = data.labels_array()
+        classes = tuple(sorted(set(labels.tolist())))
+        class_ix = {c: i for i, c in enumerate(classes)}
+        y = np.array([class_ix[l] for l in labels], dtype=np.int32)
+        model = naive_bayes.train(
+            data.features_array(), y, lambda_=self.ap.lambda_,
+            n_classes=len(classes))
+        return ClassificationModel(nb=model, class_labels=classes)
+
+    def predict(self, model: ClassificationModel,
+                query: Query) -> PredictedResult:
+        x = np.asarray([query.features], dtype=np.float32)
+        ix = int(np.asarray(naive_bayes.predict(model.nb, x))[0])
+        return PredictedResult(label=model.class_labels[ix])
+
+    def batch_predict(self, model: ClassificationModel,
+                      queries: Iterable[Tuple[int, Query]]
+                      ) -> List[Tuple[int, PredictedResult]]:
+        queries = list(queries)
+        if not queries:
+            return []
+        x = np.asarray([q.features for _qx, q in queries], dtype=np.float32)
+        ixs = np.asarray(naive_bayes.predict(model.nb, x))
+        return [(qx, PredictedResult(label=model.class_labels[int(ix)]))
+                for (qx, _q), ix in zip(queries, ixs)]

@@ -1,0 +1,241 @@
+"""ECommAlgorithm: explicit ALS + live business-rule filtering at serve time.
+
+Parity: scala-parallel-ecommercerecommendation/train-with-rate-event/src/
+main/scala/ALSAlgorithm.scala — train :49-131 (rate events, latest value
+per (user, item) wins, ALS.train); predict :133-260 (seen-events and
+unavailable-items constraints read LIVE from the event store per query,
+known users score by U[u] . V, unknown users by similarity to their
+recent views). The device-side scoring is one masked matvec + top-k;
+only the business-rule lookups touch the host event store.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, Optional, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import Algorithm, Params
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.ecommerce.data_source import TrainingData
+from predictionio_tpu.models.ecommerce.engine import (
+    Item, ItemScore, PredictedResult, Query,
+)
+from predictionio_tpu.ops import als, topk
+
+logger = logging.getLogger("predictionio_tpu.ecommerce")
+
+
+@dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    """ALSAlgorithmParams (:33-41): appName (was appId), unseenOnly,
+    seenEvents, similarEvents, rank, numIterations, lambda, seed."""
+    appName: str
+    unseenOnly: bool = False
+    seenEvents: Tuple[str, ...] = ("buy", "view")
+    similarEvents: Tuple[str, ...] = ("view",)
+    rank: int = 10
+    numIterations: int = 20
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+    JSON_ALIASES = {"lambda": "lambda_"}
+
+    def __post_init__(self):
+        for f in ("seenEvents", "similarEvents"):
+            v = getattr(self, f)
+            if not isinstance(v, tuple):
+                object.__setattr__(self, f, tuple(v))
+
+
+@dataclass
+class ECommModel:
+    """ALSModel (:43-67): both factor sides + vocabs + item metadata;
+    trained masks play the role of Option[Array] feature rows."""
+    rank: int
+    user_features: "np.ndarray"     # (n_users, rank)
+    product_features: "np.ndarray"  # (n_items, rank)
+    user_vocab: BiMap
+    item_vocab: BiMap
+    items: Dict[int, Item]
+    user_trained: "np.ndarray"      # (n_users,) bool
+    item_trained: "np.ndarray"      # (n_items,) bool
+    category_masks: Dict[str, "np.ndarray"] = None
+    product_features_hat: "np.ndarray" = None   # L2-normalized rows
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: ECommAlgorithmParams):
+        self.ap = params
+
+    # ------------------------------------------------------------- training
+    def train(self, ctx, data: TrainingData) -> ECommModel:
+        if not data.rate_events:
+            raise ValueError("rateEvents in PreparedData cannot be empty.")
+        if not data.users:
+            raise ValueError("users in PreparedData cannot be empty.")
+        if not data.items:
+            raise ValueError("items in PreparedData cannot be empty.")
+        user_vocab = BiMap.string_int(data.users.keys())
+        item_vocab = BiMap.string_int(data.items.keys())
+        # latest rating per (user, item) wins (:76-97)
+        latest: Dict[Tuple[int, int], Tuple[float, float]] = {}
+        for r in data.rate_events:
+            u, i = user_vocab.get(r.user), item_vocab.get(r.item)
+            if u is None:
+                logger.info("Couldn't convert nonexistent user ID %s", r.user)
+                continue
+            if i is None:
+                logger.info("Couldn't convert nonexistent item ID %s", r.item)
+                continue
+            cur = latest.get((u, i))
+            if cur is None or r.t > cur[0]:
+                latest[(u, i)] = (r.t, r.rating)
+        if not latest:
+            raise ValueError(
+                "ratings cannot be empty. Please check if your events "
+                "contain valid user and item ID.")
+        u_idx = np.array([u for u, _ in latest], dtype=np.int32)
+        i_idx = np.array([i for _, i in latest], dtype=np.int32)
+        vals = np.array([v for _t, v in latest.values()], dtype=np.float32)
+        seed = self.ap.seed if self.ap.seed is not None else (
+            np.random.SeedSequence().entropy % (2 ** 31))
+        prepared = als.prepare_ratings(
+            u_idx, i_idx, vals,
+            n_users=len(user_vocab), n_items=len(item_vocab))
+        U, V = als.train_explicit(
+            prepared, rank=self.ap.rank, iterations=self.ap.numIterations,
+            lambda_=self.ap.lambda_, seed=int(seed))
+        user_trained = np.zeros(len(user_vocab), dtype=bool)
+        user_trained[np.unique(u_idx)] = True
+        item_trained = np.zeros(len(item_vocab), dtype=bool)
+        item_trained[np.unique(i_idx)] = True
+        items = {item_vocab(k): v for k, v in data.items.items()}
+        from predictionio_tpu.models.similarproduct.als_algorithm import (
+            build_category_masks,
+        )
+        V = np.asarray(V)
+        V_hat = V / np.maximum(
+            np.linalg.norm(V, axis=1, keepdims=True), 1e-12)
+        return ECommModel(
+            rank=self.ap.rank, user_features=U, product_features=V,
+            user_vocab=user_vocab, item_vocab=item_vocab, items=items,
+            user_trained=user_trained, item_trained=item_trained,
+            category_masks=build_category_masks(items, len(item_vocab)),
+            product_features_hat=V_hat)
+
+    # ---------------------------------------------------------- live lookups
+    def bind_serving(self, ctx) -> None:
+        """Capture the workflow's storage for serve-time lookups so deploy
+        and eval read the same store training did, not the process-global
+        singleton (Algorithm.bind_serving hook)."""
+        self._serving_storage = getattr(ctx, "storage", None)
+
+    @property
+    def _storage(self):
+        return getattr(self, "_serving_storage", None)
+
+    def _seen_items(self, user: str) -> Set[str]:
+        """Seen events for this user, queried live (:148-176)."""
+        if not self.ap.unseenOnly:
+            return set()
+        try:
+            events = store.find_by_entity(
+                app_name=self.ap.appName, entity_type="user", entity_id=user,
+                event_names=list(self.ap.seenEvents),
+                target_entity_type="item", storage=self._storage)
+        except Exception as e:
+            logger.error("Error when read seen events: %s", e)
+            return set()
+        return {e.target_entity_id for e in events
+                if e.target_entity_id is not None}
+
+    def _unavailable_items(self) -> Set[str]:
+        """Latest $set on constraint/unavailableItems (:178-200)."""
+        try:
+            events = store.find_by_entity(
+                app_name=self.ap.appName, entity_type="constraint",
+                entity_id="unavailableItems", event_names=["$set"],
+                limit=1, latest=True, storage=self._storage)
+        except Exception as e:
+            logger.error("Error when read set unavailableItems event: %s", e)
+            return set()
+        if not events:
+            return set()
+        return set(events[0].properties.get_opt("items") or ())
+
+    # ------------------------------------------------------------- serving
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        """Known users score U[u] . V; unknown users fall back to
+        similarity with their recent views — both as one masked device
+        top-K (:202-260)."""
+        from predictionio_tpu.models.similarproduct.als_algorithm import (
+            candidate_mask,
+        )
+        white = None
+        if query.whiteList is not None:
+            white = {model.item_vocab.get(x) for x in query.whiteList}
+            white.discard(None)
+        black_names = set(query.blackList or ())
+        black_names |= self._seen_items(query.user)
+        black_names |= self._unavailable_items()
+        black = {model.item_vocab.get(x) for x in black_names}
+        black.discard(None)
+
+        user_ix = model.user_vocab.get(query.user)
+        if user_ix is not None and model.user_trained[user_ix]:
+            query_vec = jnp.asarray(model.user_features[user_ix])
+            factors = model.product_features
+        else:
+            logger.info("No userFeature found for user %s.", query.user)
+            query_vec = self._recent_views_vector(model, query.user)
+            if query_vec is None:
+                return PredictedResult(())
+            factors = model.product_features_hat
+        mask = candidate_mask(
+            n_items=len(model.item_vocab),
+            trained=model.item_trained,
+            category_masks=model.category_masks or {},
+            categories=query.categories,
+            white=white, black=black, exclude=set(),
+        )
+        if not mask.any():
+            return PredictedResult(())
+        k = min(query.num, mask.shape[0])
+        vals, idx = topk.topk_scores(
+            query_vec, jnp.asarray(factors), mask=jnp.asarray(mask), k=k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        inv = model.item_vocab.inverse()
+        return PredictedResult(tuple(
+            ItemScore(item=inv(int(ix)), score=float(s))
+            for s, ix in zip(vals, idx) if s > 0))
+
+    def _recent_views_vector(self, model: ECommModel,
+                             user: str) -> Optional[jnp.ndarray]:
+        """New-user fallback query vector: sum of normalized vectors of the
+        latest 10 similar-events items; against normalized factors this
+        scores the sum of cosines (predictNewUser, :262-330)."""
+        try:
+            events = store.find_by_entity(
+                app_name=self.ap.appName, entity_type="user", entity_id=user,
+                event_names=list(self.ap.similarEvents),
+                target_entity_type="item", limit=10, latest=True,
+                storage=self._storage)
+        except Exception as e:
+            logger.error("Error when read recent events: %s", e)
+            return None
+        recent_ixs = {model.item_vocab.get(e.target_entity_id)
+                      for e in events if e.target_entity_id is not None}
+        recent_ixs.discard(None)
+        recent_ixs = {ix for ix in recent_ixs if model.item_trained[ix]}
+        if not recent_ixs:
+            return None
+        V_hat = jnp.asarray(model.product_features_hat)
+        return jnp.sum(V_hat[jnp.asarray(sorted(recent_ixs))], axis=0)

@@ -240,14 +240,20 @@ def _half_step_implicit(other, side_idx, side_other, side_rating, counts,
                         n_self, lambda_, alpha, chunk, reg_scaling):
     """Hu-Koren-Volinsky: A_u = Y'Y + Y'(C_u - I)Y,  b_u = Y'C_u p_u.
 
-    c_ui = alpha * r_ui; p_ui = 1 for observed. The dense Y'Y term is one
-    (r, n) x (n, r) matmul; only the confidence-weighted correction runs
-    through the sparse accumulator.
+    MLlib ALS.trainImplicit parity for SIGNED ratings (used by the
+    similarproduct LikeAlgorithm's dislike = -1): confidence derives from
+    |r| (c - 1 = alpha * |r|, keeping A_u positive definite) and the
+    preference is p = 1 iff r > 0, so disliked items pull factors toward 0
+    with high confidence instead of flipping the Gram correction negative.
+    The dense Y'Y term is one (r, n) x (n, r) matmul; only the
+    confidence-weighted correction runs through the sparse accumulator.
     """
     YtY = other.T @ other                              # (r, r) MXU
-    conf = alpha * side_rating                          # c_ui
+    conf = alpha * jnp.abs(side_rating)                 # c_ui - 1 >= 0
+    pref = (side_rating > 0).astype(jnp.float32)        # p_ui
     A_corr, b = gram_rhs(
-        other, side_idx, side_other, conf, 1.0 + conf, n_self, chunk)
+        other, side_idx, side_other, conf, (1.0 + conf) * pref,
+        n_self, chunk)
     A = YtY[None] + A_corr
     if reg_scaling == "count":
         reg = lambda_ * counts.astype(jnp.float32) + _EPS

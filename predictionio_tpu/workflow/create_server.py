@@ -163,6 +163,8 @@ class QueryAPI:
             raise ValueError(f"No model data for EngineInstance {instance.id}")
         models = model_io.deserialize_models(blob.models)
         _, _, algorithms, serving = engine._instantiate(engine_params)
+        for a in algorithms:
+            a.bind_serving(self.ctx)
         models = prepare_deploy(
             self.ctx, engine, engine_params, instance.id, models,
             algorithms=algorithms)

@@ -1,0 +1,97 @@
+"""e2 library tests (ref: e2/src/test/scala/.../e2/ — NaiveBayesTest,
+MarkovChainTest, BinaryVectorizerTest, CrossValidationTest fixtures)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer, CategoricalNaiveBayes, LabeledPoint, MarkovChain,
+    split_data,
+)
+
+
+@pytest.fixture()
+def nb_points():
+    # the reference's NaiveBayesFixture: labels by weather-ish categoricals
+    return [
+        LabeledPoint("yes", ("sunny", "hot")),
+        LabeledPoint("yes", ("sunny", "mild")),
+        LabeledPoint("yes", ("overcast", "mild")),
+        LabeledPoint("no", ("rain", "mild")),
+        LabeledPoint("no", ("rain", "hot")),
+    ]
+
+
+def test_categorical_nb_priors_and_likelihoods(nb_points):
+    model = CategoricalNaiveBayes.train(nb_points)
+    assert model.priors["yes"] == pytest.approx(math.log(3 / 5))
+    assert model.priors["no"] == pytest.approx(math.log(2 / 5))
+    # P(sunny | yes) = 2/3, no smoothing
+    assert model.likelihoods["yes"][0]["sunny"] == pytest.approx(
+        math.log(2 / 3))
+    assert "sunny" not in model.likelihoods["no"][0]
+    assert model.feature_count == 2
+
+
+def test_categorical_nb_predict_and_log_score(nb_points):
+    model = CategoricalNaiveBayes.train(nb_points)
+    assert model.predict(("sunny", "hot")) == "yes"
+    assert model.predict(("rain", "mild")) == "no"
+    # log_score: None for unknown label; -inf default for unseen value
+    assert model.log_score(LabeledPoint("maybe", ("sunny", "hot"))) is None
+    s = model.log_score(LabeledPoint("no", ("sunny", "hot")))
+    assert s == float("-inf")
+    # custom default likelihood (CategoricalNaiveBayes.scala:96-101)
+    s = model.log_score(LabeledPoint("no", ("sunny", "hot")),
+                        default_likelihood=lambda ls: min(ls) - 1.0)
+    assert s is not None and s > float("-inf")
+
+
+def test_markov_chain_topn_and_predict():
+    # transitions: 0->1 x3, 0->2 x1, 1->0 x2; topN=1 keeps the best per row
+    model = MarkovChain.train(
+        rows=[0, 0, 1], cols=[1, 2, 0], counts=[3.0, 1.0, 2.0],
+        n_states=3, top_n=1)
+    t = np.asarray(model.transition)
+    assert t[0, 1] == pytest.approx(0.75)   # 3 / (3+1), full-row total
+    assert t[0, 2] == 0.0                   # truncated by top-1
+    assert t[1, 0] == pytest.approx(1.0)
+    nxt = model.predict([1.0, 0.0, 0.0])
+    assert nxt[1] == pytest.approx(0.75) and nxt[0] == 0.0
+
+
+def test_binary_vectorizer():
+    vec = BinaryVectorizer.from_maps(
+        [{"color": "red", "size": "L", "junk": "x"},
+         {"color": "blue", "size": "L"}],
+        properties=["color", "size"])
+    assert vec.num_features == 3  # (blue), (red), (L)
+    v = vec.to_binary([("color", "red"), ("size", "L")])
+    assert v.sum() == 2.0 and v.dtype == np.float32
+    # unknown pair ignored
+    assert vec.to_binary([("color", "green")]).sum() == 0.0
+    batch = vec.to_binary_batch([[("color", "red")], [("size", "L")]])
+    assert batch.shape == (2, 3)
+    v2 = BinaryVectorizer.from_pairs([("a", "1"), ("b", "2")])
+    assert v2.to_binary([("b", "2")]).tolist() == [0.0, 1.0]
+
+
+def test_split_data_folds():
+    data = list(range(10))
+    folds = split_data(
+        eval_k=3, dataset=data, evaluator_info="EI",
+        training_data_creator=list,
+        query_creator=lambda d: ("q", d),
+        actual_creator=lambda d: ("a", d))
+    assert len(folds) == 3
+    for f, (train, ei, qa) in enumerate(folds):
+        assert ei == "EI"
+        test_points = [d for _q, (_tag, d) in
+                       [(q, q) for q, _a in qa]]
+        assert all(d % 3 == f for d in test_points)
+        assert sorted(train + test_points) == data
+    # every point appears in exactly one test fold
+    all_test = [d for _td, _ei, qa in folds for (_t, d), _a in qa]
+    assert sorted(all_test) == data
