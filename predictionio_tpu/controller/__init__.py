@@ -30,6 +30,9 @@ from predictionio_tpu.controller.evaluation import (
 from predictionio_tpu.controller.persistent_model import (
     LocalFileSystemPersistentModel, PersistentModel,
 )
+from predictionio_tpu.controller.self_cleaning import (
+    EventWindow, SelfCleaningDataSource,
+)
 
 __all__ = [
     "Algorithm", "DataSource", "EmptyActualResult", "EmptyEvaluationInfo",
@@ -42,4 +45,5 @@ __all__ = [
     "StdevMetric", "SumMetric", "ZeroMetric",
     "EngineParamsGenerator", "Evaluation", "MetricEvaluator", "MetricScores",
     "LocalFileSystemPersistentModel", "PersistentModel",
+    "EventWindow", "SelfCleaningDataSource",
 ]

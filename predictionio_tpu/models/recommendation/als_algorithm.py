@@ -26,11 +26,14 @@ from predictionio_tpu.ops import als, topk
 @dataclass(frozen=True)
 class ALSAlgorithmParams(Params):
     """engine.json keys (rank, numIterations, lambda, seed) — `lambda` is a
-    Python keyword, accepted via the alias (ALSAlgorithm.scala:30-37)."""
+    Python keyword, accepted via the alias (ALSAlgorithm.scala:30-37).
+    checkpointInterval additionally snapshots factors every N iterations
+    so an interrupted train resumes (improvement; no reference analogue)."""
     rank: int = 10
     numIterations: int = 10
     lambda_: float = 0.01
     seed: Optional[int] = None
+    checkpointInterval: Optional[int] = None
 
     # engine.json uses "lambda"; dataclass fields cannot, so extraction maps it
     JSON_ALIASES = {"lambda": "lambda_"}
@@ -73,6 +76,11 @@ class ALSAlgorithm(Algorithm):
             td.user_idx, td.item_idx, td.rating,
             n_users=len(td.user_vocab), n_items=len(td.item_vocab))
         if ctx is not None and getattr(ctx, "mesh", None) is not None:
+            if self.ap.checkpointInterval:
+                import logging
+                logging.getLogger("predictionio_tpu.recommendation").warning(
+                    "checkpointInterval is not yet supported on the "
+                    "mesh-sharded path; training without snapshots")
             from predictionio_tpu.parallel import als_dist
             U, V = als_dist.train_explicit_sharded(
                 ctx.mesh, data, rank=self.ap.rank,
@@ -81,9 +89,18 @@ class ALSAlgorithm(Algorithm):
             U = U[: len(td.user_vocab)]
             V = V[: len(td.item_vocab)]
         else:
+            checkpointer = None
+            ckpt_dir = getattr(ctx, "checkpoint_dir", None)
+            if self.ap.checkpointInterval and ckpt_dir:
+                from predictionio_tpu.workflow.checkpoint import (
+                    FactorCheckpointer,
+                )
+                checkpointer = FactorCheckpointer(ckpt_dir)
             U, V = als.train_explicit(
                 data, rank=self.ap.rank, iterations=self.ap.numIterations,
-                lambda_=self.ap.lambda_, seed=int(seed))
+                lambda_=self.ap.lambda_, seed=int(seed),
+                checkpoint_every=self.ap.checkpointInterval,
+                checkpointer=checkpointer)
         return ALSModel(
             rank=self.ap.rank, user_factors=U, item_factors=V,
             user_vocab=td.user_vocab, item_vocab=td.item_vocab)

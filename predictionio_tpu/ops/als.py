@@ -189,17 +189,14 @@ def init_factors(key, n: int, rank: int) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=(
-    "rank", "iterations", "n_users", "n_items", "chunk", "reg_scaling"))
+    "iterations", "n_users", "n_items", "chunk", "reg_scaling"))
 def _train_explicit_jit(
     u_self, u_other, u_rating, u_counts,
     i_self, i_other, i_rating, i_counts,
-    rank: int, iterations: int, lambda_: float, seed: int,
+    U0, V0,
+    iterations: int, lambda_: float,
     n_users: int, n_items: int, chunk: int, reg_scaling: str,
 ):
-    ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-    U = init_factors(ku, n_users, rank)
-    V = init_factors(ki, n_items, rank)
-
     def one_iter(_, UV):
         U, V = UV
         U = _half_step_explicit(V, u_self, u_other, u_rating, u_counts,
@@ -208,7 +205,39 @@ def _train_explicit_jit(
                                 n_items, lambda_, chunk, reg_scaling)
         return (U, V)
 
-    U, V = lax.fori_loop(0, iterations, one_iter, (U, V))
+    return lax.fori_loop(0, iterations, one_iter, (U0, V0))
+
+
+def _seed_factors(seed: int, n_users: int, n_items: int, rank: int):
+    ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+    return init_factors(ku, n_users, rank), init_factors(ki, n_items, rank)
+
+
+def _run_segmented(run, u0, v0, iterations: int,
+                   checkpoint_every: Optional[int], checkpointer):
+    """Shared restore + segmented-execution loop for both trainers.
+
+    `run(u, v, n_iters)` executes one compiled segment. Intermediate
+    snapshots only: the final state persists via the model blob.
+    """
+    start = 0
+    if checkpointer is not None:
+        restored = checkpointer.latest()
+        if restored is not None:
+            start, arrays = restored
+            u0, v0 = arrays["U"], arrays["V"]
+    if start >= iterations:
+        return u0, v0
+    if checkpoint_every is None or checkpointer is None:
+        return run(u0, v0, iterations - start)
+    U, V = u0, v0
+    step = start
+    while step < iterations:
+        seg = min(checkpoint_every, iterations - step)
+        U, V = run(U, V, seg)
+        step += seg
+        if step < iterations:
+            checkpointer.save(step, {"U": np.asarray(U), "V": np.asarray(V)})
     return U, V
 
 
@@ -220,20 +249,36 @@ def train_explicit(
     seed: int = 3,
     chunk: int = 1 << 18,
     reg_scaling: str = "count",
+    u0=None,
+    v0=None,
+    checkpoint_every: Optional[int] = None,
+    checkpointer=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """ALS.train parity (defaults = recommendation-engine engine.json:14-17).
 
     Returns (user_factors (n_users, rank), item_factors (n_items, rank)).
+    u0/v0 warm-start the factors (resume path); with checkpoint_every and
+    a checkpointer (workflow.checkpoint.FactorCheckpointer protocol:
+    save(step, {...}) / latest() -> (step, {...}) | None), training runs
+    in compiled segments and snapshots factors between them — the
+    iteration-level resume the reference lacks (SURVEY.md §5
+    checkpoint/resume).
     """
     bu, bi = data.by_user, data.by_item
     chunk = min(chunk, bu.self_idx.shape[0], bi.self_idx.shape[0])
-    return _train_explicit_jit(
-        bu.self_idx, bu.other_idx, bu.rating, bu.counts,
-        bi.self_idx, bi.other_idx, bi.rating, bi.counts,
-        rank=rank, iterations=iterations, lambda_=float(lambda_),
-        seed=int(seed), n_users=data.n_users, n_items=data.n_items,
-        chunk=chunk, reg_scaling=reg_scaling,
-    )
+    if u0 is None or v0 is None:
+        u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
+
+    def run(u, v, n_iters):
+        return _train_explicit_jit(
+            bu.self_idx, bu.other_idx, bu.rating, bu.counts,
+            bi.self_idx, bi.other_idx, bi.rating, bi.counts,
+            u, v, iterations=n_iters, lambda_=float(lambda_),
+            n_users=data.n_users, n_items=data.n_items,
+            chunk=chunk, reg_scaling=reg_scaling)
+
+    return _run_segmented(run, u0, v0, iterations, checkpoint_every,
+                          checkpointer)
 
 
 def _half_step_implicit(other, side_idx, side_other, side_rating, counts,
@@ -263,17 +308,14 @@ def _half_step_implicit(other, side_idx, side_other, side_rating, counts,
 
 
 @partial(jax.jit, static_argnames=(
-    "rank", "iterations", "n_users", "n_items", "chunk", "reg_scaling"))
+    "iterations", "n_users", "n_items", "chunk", "reg_scaling"))
 def _train_implicit_jit(
     u_self, u_other, u_rating, u_counts,
     i_self, i_other, i_rating, i_counts,
-    rank: int, iterations: int, lambda_: float, alpha: float, seed: int,
+    U0, V0,
+    iterations: int, lambda_: float, alpha: float,
     n_users: int, n_items: int, chunk: int, reg_scaling: str,
 ):
-    ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-    U = init_factors(ku, n_users, rank)
-    V = init_factors(ki, n_items, rank)
-
     def one_iter(_, UV):
         U, V = UV
         U = _half_step_implicit(V, u_self, u_other, u_rating, u_counts,
@@ -282,8 +324,7 @@ def _train_implicit_jit(
                                 n_items, lambda_, alpha, chunk, reg_scaling)
         return (U, V)
 
-    U, V = lax.fori_loop(0, iterations, one_iter, (U, V))
-    return U, V
+    return lax.fori_loop(0, iterations, one_iter, (U0, V0))
 
 
 def train_implicit(
@@ -295,21 +336,32 @@ def train_implicit(
     seed: int = 3,
     chunk: int = 1 << 18,
     reg_scaling: str = "count",
+    u0=None,
+    v0=None,
+    checkpoint_every: Optional[int] = None,
+    checkpointer=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """ALS.trainImplicit parity (similarproduct/ecommerce templates).
 
     `rating` carries the implicit preference weight (view counts etc.);
-    padding rows have weight 0 so they contribute nothing.
+    padding rows have weight 0 so they contribute nothing. Checkpoint
+    semantics match train_explicit.
     """
     bu, bi = data.by_user, data.by_item
     chunk = min(chunk, bu.self_idx.shape[0], bi.self_idx.shape[0])
-    return _train_implicit_jit(
-        bu.self_idx, bu.other_idx, bu.rating, bu.counts,
-        bi.self_idx, bi.other_idx, bi.rating, bi.counts,
-        rank=rank, iterations=iterations, lambda_=float(lambda_),
-        alpha=float(alpha), seed=int(seed), n_users=data.n_users,
-        n_items=data.n_items, chunk=chunk, reg_scaling=reg_scaling,
-    )
+    if u0 is None or v0 is None:
+        u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
+
+    def run(u, v, n_iters):
+        return _train_implicit_jit(
+            bu.self_idx, bu.other_idx, bu.rating, bu.counts,
+            bi.self_idx, bi.other_idx, bi.rating, bi.counts,
+            u, v, iterations=n_iters, lambda_=float(lambda_),
+            alpha=float(alpha), n_users=data.n_users, n_items=data.n_items,
+            chunk=chunk, reg_scaling=reg_scaling)
+
+    return _run_segmented(run, u0, v0, iterations, checkpoint_every,
+                          checkpointer)
 
 
 # ---------------------------------------------------------------------------

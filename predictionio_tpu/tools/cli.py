@@ -88,6 +88,7 @@ def cmd_train(args) -> int:
         engine_variant=variant.get("id", "default"),
         engine_factory=variant["engineFactory"],
         params_json=variant,
+        resume_from=args.resume_from,
     )
     _info(f"Training completed. EngineInstance ID: {instance_id}")
     return 0
@@ -350,6 +351,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("train", help="train an engine instance")
     engine_flags(sp)
     sp.add_argument("--batch", default="", help="batch label")
+    sp.add_argument("--resume-from", default=None,
+                    help="instance id of a crashed run whose iteration "
+                         "snapshots should seed this training")
 
     sp = sub.add_parser("eval", help="run an evaluation")
     sp.add_argument("evaluation_class")

@@ -42,9 +42,14 @@ def run_train(
     engine_variant: str = "default",
     engine_factory: str = "",
     params_json: Optional[dict] = None,
+    resume_from: Optional[str] = None,
 ) -> str:
     """Run one training; returns the COMPLETED EngineInstance id
-    (CoreWorkflow.runTrain, CoreWorkflow.scala:45-101)."""
+    (CoreWorkflow.runTrain, CoreWorkflow.scala:45-101).
+
+    resume_from: instance id of a prior FAILED run — its iteration
+    snapshots (if the algorithm checkpoints) seed this run instead of
+    starting from iteration 0."""
     storage = ctx.storage
     instances = storage.get_meta_data_engine_instances()
     import json as _json
@@ -61,6 +66,11 @@ def run_train(
     )
     instance_id = instances.insert(instance)
     logger.info("EngineInstance %s created (INIT)", instance_id)
+    # iteration-checkpoint location for algorithms that opt in (an
+    # improvement over the reference; workflow/checkpoint.py). Resuming a
+    # crashed run reuses ITS directory so saved snapshots are consulted.
+    from predictionio_tpu.workflow.checkpoint import run_checkpoint_dir
+    ctx.checkpoint_dir = run_checkpoint_dir(resume_from or instance_id)
     try:
         models = engine.train(ctx, engine_params)
         models = engine.make_serializable_models(
@@ -72,6 +82,9 @@ def run_train(
             **{**row.__dict__, "status": "COMPLETED", "end_time": _now()}))
         logger.info("Training completed; EngineInstance %s COMPLETED "
                     "(model blob %d bytes)", instance_id, len(blob))
+        # the model blob persists the final state; snapshots are scratch
+        from predictionio_tpu.workflow.checkpoint import FactorCheckpointer
+        FactorCheckpointer(ctx.checkpoint_dir).clear()
         return instance_id
     except Exception:
         row = instances.get(instance_id)

@@ -1,0 +1,145 @@
+"""Iteration-level checkpoint/resume tests (framework improvement over the
+reference; SURVEY.md §5 checkpoint/resume)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.workflow.checkpoint import FactorCheckpointer
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.default_rng(7)
+    n_users, n_items, nnz = 40, 30, 400
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = rng.uniform(1, 5, nnz).astype(np.float32)
+    return als.prepare_ratings(u, i, r, n_users=n_users, n_items=n_items,
+                               chunk=128)
+
+
+def test_checkpointer_save_latest_keep(tmp_path):
+    ckpt = FactorCheckpointer(str(tmp_path), keep=2)
+    assert ckpt.latest() is None
+    for step in (2, 4, 6):
+        ckpt.save(step, {"U": np.full((3,), step, dtype=np.float32)})
+    assert ckpt.steps() == [4, 6]  # keep=2 pruned step 2
+    step, arrays = ckpt.latest()
+    assert step == 6 and arrays["U"][0] == 6.0
+    ckpt.clear()
+    assert ckpt.latest() is None
+
+
+def test_segmented_equals_straight_run(data, tmp_path):
+    """Checkpointed training must be bit-identical to a straight run: the
+    segments chain factor state, not RNG state."""
+    U1, V1 = als.train_explicit(data, rank=4, iterations=6, seed=3,
+                                chunk=128)
+    ckpt = FactorCheckpointer(str(tmp_path))
+    U2, V2 = als.train_explicit(data, rank=4, iterations=6, seed=3,
+                                chunk=128, checkpoint_every=2,
+                                checkpointer=ckpt)
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
+    np.testing.assert_array_equal(np.asarray(V1), np.asarray(V2))
+    assert ckpt.steps() == [2, 4]  # intermediate snapshots only
+
+
+def test_resume_from_interruption(data, tmp_path):
+    """Simulate a crash after iteration 4 of 6: the rerun must resume from
+    the snapshot and produce the same factors as an uninterrupted run."""
+    ckpt = FactorCheckpointer(str(tmp_path))
+
+    class Boom(RuntimeError):
+        pass
+
+    class FailingCheckpointer(FactorCheckpointer):
+        def save(self, step, arrays):
+            super().save(step, arrays)
+            if step == 4:
+                raise Boom()
+
+    failing = FailingCheckpointer(str(tmp_path))
+    with pytest.raises(Boom):
+        als.train_explicit(data, rank=4, iterations=6, seed=3, chunk=128,
+                           checkpoint_every=2, checkpointer=failing)
+    assert ckpt.latest()[0] == 4
+    U2, V2 = als.train_explicit(data, rank=4, iterations=6, seed=3,
+                                chunk=128, checkpoint_every=2,
+                                checkpointer=ckpt)
+    U1, V1 = als.train_explicit(data, rank=4, iterations=6, seed=3,
+                                chunk=128)
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
+    np.testing.assert_array_equal(np.asarray(V1), np.asarray(V2))
+
+
+def test_workflow_resume_from_crashed_run(data, tmp_path, memory_storage,
+                                          monkeypatch):
+    """run_train(resume_from=<crashed id>) consults the crashed run's
+    snapshots (the reviewer scenario: without resume_from each rerun got a
+    fresh empty checkpoint dir and silently restarted from iteration 0)."""
+    import json
+
+    from predictionio_tpu.data import store as dstore
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.workflow import WorkflowContext, run_train
+    from predictionio_tpu.workflow.checkpoint import (
+        FactorCheckpointer, run_checkpoint_dir,
+    )
+
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "RApp"))
+    memory_storage.get_events().init(app_id)
+    evs = []
+    for u in range(6):
+        for i in range(5):
+            evs.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(1 + (u + i) % 5)})))
+    dstore.write(evs, app_id, storage=memory_storage)
+
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="RApp"),
+        algorithm_params_list=(("als", ALSAlgorithmParams(
+            rank=3, numIterations=6, seed=3, checkpointInterval=2)),))
+    ctx = WorkflowContext(storage=memory_storage)
+
+    # fake a crashed run: snapshots exist under its instance id
+    crashed_id = "crashed-run"
+    probe = np.full((6, 3), 7.0, dtype=np.float32)
+    FactorCheckpointer(run_checkpoint_dir(crashed_id)).save(
+        4, {"U": probe, "V": np.full((5, 3), 7.0, dtype=np.float32)})
+
+    iid = run_train(ctx, engine, ep, engine_factory="x",
+                    resume_from=crashed_id)
+    # the resumed dir is cleared on success
+    assert FactorCheckpointer(run_checkpoint_dir(crashed_id)).latest() is None
+    # the run trained only iterations 5..6 from the probe factors: the
+    # result must differ from a full 6-iteration run from the cold seed
+    from predictionio_tpu.workflow import model_io
+    blob = memory_storage.get_model_data_models().get(iid)
+    resumed = model_io.deserialize_models(blob.models)[0]
+    cold_iid = run_train(WorkflowContext(storage=memory_storage), engine, ep,
+                         engine_factory="x")
+    cold = model_io.deserialize_models(
+        memory_storage.get_model_data_models().get(cold_iid).models)[0]
+    assert not np.allclose(resumed.user_factors, cold.user_factors)
+
+
+def test_implicit_checkpoint_roundtrip(data, tmp_path):
+    ckpt = FactorCheckpointer(str(tmp_path))
+    U1, V1 = als.train_implicit(data, rank=4, iterations=4, seed=5,
+                                chunk=128)
+    U2, V2 = als.train_implicit(data, rank=4, iterations=4, seed=5,
+                                chunk=128, checkpoint_every=3,
+                                checkpointer=ckpt)
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
+    np.testing.assert_array_equal(np.asarray(V1), np.asarray(V2))

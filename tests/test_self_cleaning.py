@@ -1,0 +1,123 @@
+"""SelfCleaningDataSource tests (ref: core/src/test/scala/.../
+SelfCleaningDataSourceTest semantics)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.controller.self_cleaning import (
+    EventWindow, SelfCleaningDataSource, parse_duration,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+
+UTC = dt.timezone.utc
+NOW = dt.datetime(2021, 6, 10, tzinfo=UTC)
+
+
+def ev(name, entity, props=None, day=1, **kw):
+    return Event(
+        event=name, entity_type="user", entity_id=entity,
+        properties=DataMap(props or {}),
+        event_time=dt.datetime(2021, 6, day, tzinfo=UTC), **kw)
+
+
+class _DS(SelfCleaningDataSource):
+    app_name = "CleanApp"
+
+    def __init__(self, window):
+        self.event_window = window
+
+
+@pytest.fixture()
+def app(memory_storage):
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "CleanApp"))
+    memory_storage.get_events().init(app_id)
+    return app_id
+
+
+def test_parse_duration():
+    assert parse_duration("3 days") == dt.timedelta(days=3)
+    assert parse_duration("12h") == dt.timedelta(hours=12)
+    assert parse_duration("90 seconds") == dt.timedelta(seconds=90)
+    with pytest.raises(ValueError):
+        parse_duration("sideways")
+
+
+def test_window_keeps_recent_and_set_events(memory_storage, app):
+    store.write([
+        ev("buy", "u1", day=1),          # old -> dropped
+        ev("buy", "u1", day=9),          # recent -> kept
+        ev("$set", "u1", {"a": 1}, day=1),   # $set always kept
+    ], app, storage=memory_storage)
+    ds = _DS(EventWindow(duration="3 days"))
+    cleaned = ds.clean_events(storage=memory_storage, now=NOW)
+    assert {(e.event, e.event_time.day) for e in cleaned} == {
+        ("buy", 9), ("$set", 1)}
+    # no window -> everything
+    assert len(_DS(None).clean_events(storage=memory_storage, now=NOW)) == 3
+
+
+def test_compress_properties_per_entity(memory_storage, app):
+    store.write([
+        ev("$set", "u1", {"a": 1, "b": 2}, day=1),
+        ev("$unset", "u1", {"b": None}, day=2),
+        ev("$set", "u1", {"c": 3}, day=3),
+        ev("$set", "u2", {"x": 9}, day=2),
+        ev("buy", "u1", day=4),
+    ], app, storage=memory_storage)
+    ds = _DS(EventWindow(compress_properties=True))
+    cleaned = ds.clean_events(storage=memory_storage, now=NOW)
+    sets = {e.entity_id: e for e in cleaned if e.event == "$set"}
+    assert sets["u1"].properties.to_dict() == {"a": 1, "c": 3}
+    assert sets["u1"].event_time.day == 3  # last write's time
+    assert sets["u2"].properties.to_dict() == {"x": 9}
+    assert sum(1 for e in cleaned if e.event == "buy") == 1
+
+
+def test_compress_chain_starting_with_unset(memory_storage, app):
+    """A chain whose first event is $unset must still compress to a $set
+    of the surviving fields, not a mislabeled $unset."""
+    store.write([
+        ev("$unset", "u1", {"b": None}, day=1),
+        ev("$set", "u1", {"a": 1}, day=2),
+    ], app, storage=memory_storage)
+    ds = _DS(EventWindow(compress_properties=True))
+    cleaned = ds.clean_events(storage=memory_storage, now=NOW)
+    assert len(cleaned) == 1
+    assert cleaned[0].event == "$set"
+    assert cleaned[0].properties.to_dict() == {"a": 1}
+
+
+def test_remove_duplicates_keeps_first(memory_storage, app):
+    store.write([
+        ev("buy", "u1", {"q": 1}, day=2),
+        ev("buy", "u1", {"q": 1}, day=5),    # duplicate (times differ)
+        ev("buy", "u1", {"q": 2}, day=5),    # different properties -> kept
+    ], app, storage=memory_storage)
+    ds = _DS(EventWindow(remove_duplicates=True))
+    cleaned = ds.clean_events(storage=memory_storage, now=NOW)
+    assert len(cleaned) == 2
+    kept = [e for e in cleaned if e.properties.to_dict() == {"q": 1}]
+    assert kept[0].event_time.day == 2  # earliest kept
+
+
+def test_clean_persisted_events_rewrites_store(memory_storage, app):
+    store.write([
+        ev("$set", "u1", {"a": 1}, day=1),
+        ev("$set", "u1", {"b": 2}, day=2),
+        ev("buy", "u1", day=3),
+        ev("buy", "u1", day=3),
+    ], app, storage=memory_storage)
+    ds = _DS(EventWindow(compress_properties=True, remove_duplicates=True))
+    ds.clean_persisted_events(storage=memory_storage, now=NOW)
+    after = list(store.find("CleanApp", storage=memory_storage))
+    sets = [e for e in after if e.event == "$set"]
+    buys = [e for e in after if e.event == "buy"]
+    assert len(sets) == 1 and sets[0].properties.to_dict() == {"a": 1, "b": 2}
+    assert len(buys) == 1
+    # idempotent second run
+    ds.clean_persisted_events(storage=memory_storage, now=NOW)
+    assert len(list(store.find("CleanApp", storage=memory_storage))) == 2
