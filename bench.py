@@ -39,6 +39,18 @@ def synth_ratings(n_users: int, n_items: int, nnz: int, seed: int = 3):
 def main() -> None:
     import jax
 
+    # persistent compile cache: the program is identical across runs on the
+    # same libtpu, so only the first bench on a machine pays compilation
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     from predictionio_tpu.ops import als, topk
 
     n_users = int(os.environ.get("BENCH_USERS", 138_000))
@@ -46,13 +58,19 @@ def main() -> None:
     nnz = int(os.environ.get("BENCH_NNZ", 20_000_000))
     iters = int(os.environ.get("BENCH_ITERS", 10))
 
-    u, i, r = synth_ratings(n_users, n_items, nnz)
+    u, i, r = synth_ratings(n_users, n_items, nnz)   # data GENERATION
+    t0 = time.perf_counter()
     data = als.prepare_ratings(u, i, r, n_users=n_users, n_items=n_items)
+    etl_s = time.perf_counter() - t0                 # framework ETL only
 
-    # Warm-up: compile the full training program once (cached thereafter).
-    warm = als.prepare_ratings(u[:1024], i[:1024], r[:1024],
-                               n_users=n_users, n_items=n_items)
-    als.train_explicit(warm, rank=10, iterations=1, lambda_=0.01, seed=3)
+    # Warm-up at FULL shapes: iteration count is traced, so this compiles
+    # the exact program the timed run reuses (reported separately — a
+    # long-lived trainer pays it once per shape, and the persistent
+    # compilation cache pays it once per machine).
+    t0 = time.perf_counter()
+    jax.block_until_ready(als.train_explicit(
+        data, rank=10, iterations=1, lambda_=0.01, seed=3))
+    compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     U, V = als.train_explicit(data, rank=10, iterations=iters,
@@ -89,6 +107,8 @@ def main() -> None:
             "nnz": nnz, "rank": 10, "iterations": iters,
             "throughput_ratings_per_s": round(nnz * iters / train_s),
             "predict_p50_ms": round(p50_ms, 3),
+            "etl_s": round(etl_s, 3),
+            "compile_plus_first_iter_s": round(compile_s, 3),
             "device": str(jax.devices()[0]).split(":")[0],
         },
     }))
