@@ -27,8 +27,14 @@ class PluginContextBase:
             self.register(p)
 
     def register(self, plugin) -> None:
-        kind = (plugin.plugin_type
-                if plugin.plugin_type in self._by_kind else self.SNIFFER_KIND)
+        kind = plugin.plugin_type
+        if kind not in self._by_kind:
+            # a typo'd blocker silently demoted to sniffer would never
+            # block — refuse the registration outright
+            raise ValueError(
+                f"plugin {plugin.plugin_name!r} has unknown plugin_type "
+                f"{kind!r}; expected {self.BLOCKER_KIND!r} or "
+                f"{self.SNIFFER_KIND!r}")
         self._by_kind[kind][plugin.plugin_name] = plugin
 
     def kind(self, plugin_type: str) -> Dict[str, Any]:

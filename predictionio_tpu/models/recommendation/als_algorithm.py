@@ -75,27 +75,22 @@ class ALSAlgorithm(Algorithm):
         data = als.prepare_ratings(
             td.user_idx, td.item_idx, td.rating,
             n_users=len(td.user_vocab), n_items=len(td.item_vocab))
+        checkpointer = None
+        ckpt_dir = getattr(ctx, "checkpoint_dir", None)
+        if self.ap.checkpointInterval and ckpt_dir:
+            from predictionio_tpu.workflow.checkpoint import (
+                FactorCheckpointer,
+            )
+            checkpointer = FactorCheckpointer(ckpt_dir)
         if ctx is not None and getattr(ctx, "mesh", None) is not None:
-            if self.ap.checkpointInterval:
-                import logging
-                logging.getLogger("predictionio_tpu.recommendation").warning(
-                    "checkpointInterval is not yet supported on the "
-                    "mesh-sharded path; training without snapshots")
             from predictionio_tpu.parallel import als_dist
             U, V = als_dist.train_explicit_sharded(
                 ctx.mesh, data, rank=self.ap.rank,
                 iterations=self.ap.numIterations,
-                lambda_=self.ap.lambda_, seed=int(seed))
-            U = U[: len(td.user_vocab)]
-            V = V[: len(td.item_vocab)]
+                lambda_=self.ap.lambda_, seed=int(seed),
+                checkpoint_every=self.ap.checkpointInterval,
+                checkpointer=checkpointer)
         else:
-            checkpointer = None
-            ckpt_dir = getattr(ctx, "checkpoint_dir", None)
-            if self.ap.checkpointInterval and ckpt_dir:
-                from predictionio_tpu.workflow.checkpoint import (
-                    FactorCheckpointer,
-                )
-                checkpointer = FactorCheckpointer(ckpt_dir)
             U, V = als.train_explicit(
                 data, rank=self.ap.rank, iterations=self.ap.numIterations,
                 lambda_=self.ap.lambda_, seed=int(seed),

@@ -246,6 +246,20 @@ def _run_segmented(run, u0, v0, iterations: int,
         restored = checkpointer.latest()
         if restored is not None:
             start, arrays = restored
+            expect_u = tuple(np.shape(u0))
+            expect_v = tuple(np.shape(v0))
+            got_u = tuple(np.shape(arrays["U"]))
+            got_v = tuple(np.shape(arrays["V"]))
+            # rank/entity-count drift (engine.json edited between runs) must
+            # fail loudly, not silently train at the snapshot's rank
+            if got_u != expect_u or got_v != expect_v:
+                raise ValueError(
+                    "incompatible checkpoint: snapshot factors are "
+                    f"U{got_u} / V{got_v} but this run expects "
+                    f"U{expect_u} / V{expect_v}; the engine params "
+                    "(rank) or training data changed since the snapshot "
+                    "was written — delete the checkpoint directory or "
+                    "restore the original params to resume")
             u0, v0 = arrays["U"], arrays["V"]
     if start >= iterations:
         return u0, v0

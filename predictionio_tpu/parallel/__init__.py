@@ -3,7 +3,7 @@ shuffle/broadcast/executor topology (SURVEY.md §2.7).
 """
 
 from predictionio_tpu.parallel.mesh import (
-    get_mesh, local_device_count, pad_to_multiple, shard_rows,
+    get_mesh, local_device_count, pad_to_multiple,
 )
 
-__all__ = ["get_mesh", "local_device_count", "pad_to_multiple", "shard_rows"]
+__all__ = ["get_mesh", "local_device_count", "pad_to_multiple"]

@@ -1,22 +1,35 @@
 """Block-sharded ALS over a 1-D device mesh.
 
 The TPU-native replacement for MLlib ALS's block-to-block shuffle
-(SURVEY.md §2.7 "Model (block) parallelism"): users and items are split into
-contiguous blocks, one block per device. Each half-iteration is entirely
-local — a device solves its own user (item) block against a replicated copy
-of the opposite factors — followed by ONE tiled all-gather over the mesh
-axis to re-replicate the freshly solved side. Collectives ride ICI; no
-scatter/shuffle ever crosses devices.
+(SURVEY.md §2.7 "Model (block) parallelism"): rows (users / items) are
+assigned to devices by **serpentine dealing over the nnz-descending order**
+— sort rows by rating count, deal round k to devices left-to-right on even
+rounds and right-to-left on odd ones. This keeps rows-per-device at exactly
+ceil(n / n_dev) (so padded factor tensors stay within one row of minimal —
+no all-gather/HBM blowup under skew) while nnz-per-device stays near
+total / n_dev even for power-law data, where a uniform contiguous row split
+would make every device pay the hottest block's padded compute. Each
+half-iteration is entirely local — a device solves its own user (item) block
+against a replicated copy of the opposite factors — followed by ONE tiled
+all-gather over the mesh axis to re-replicate the freshly solved side.
+Collectives ride ICI; no scatter/shuffle ever crosses devices.
 
-Factor-exchange volume per iteration = |U| + |V| floats (two all-gathers),
-versus MLlib's per-iteration shuffle of factor blocks + ratings join.
+Factor-exchange volume per iteration = |U| + |V| floats (+ at most one
+padding row per device; two all-gathers), versus MLlib's per-iteration
+shuffle of factor blocks + ratings join.
+
+Determinism: factors are seeded ON HOST once (the same `_seed_factors` the
+single-device path uses) and `device_put` row-sharded, so a 1-device and an
+n-device run of the same seed start from identical factors; results agree to
+float accumulation order. Checkpoint/resume shares `_run_segmented` with the
+single-device trainers — snapshots are canonical (n_users, rank) /
+(n_items, rank) arrays, interchangeable between the two paths.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial  # noqa: F401
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +38,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.als import (
-    ALSData, COOSide, _half_step_explicit, _half_step_implicit, init_factors,
+    ALSData, COOSide, _half_step_explicit, _half_step_implicit,
+    _run_segmented, _seed_factors,
 )
 
 
@@ -33,49 +47,181 @@ from predictionio_tpu.ops.als import (
 class ShardedSide:
     """One orientation of the ratings, laid out for n_dev devices.
 
-    Flat arrays are (n_dev * nnz_dev,) so a P("block") spec gives each
-    device a (nnz_dev,) slice; self indices are block-local; counts are
-    (n_dev * rows_dev,). Padding rows use local index rows_dev.
+    Rows (users or items) are dealt to devices serpentine-style over the
+    nnz-descending order, so every device holds exactly `rows_dev` =
+    ceil(n_self / n_dev) row slots and near-equal nnz. Flat arrays are
+    (n_dev * nnz_dev,) so a P("block") spec gives each device a (nnz_dev,)
+    slice; `self_idx` is block-local (padding entries use rows_dev, a dummy
+    row); `other_idx` is PRE-REMAPPED into the opposite side's padded
+    gathered address space (d * rows_dev + local), so the device kernel
+    indexes the all-gathered factor tensor directly. `pos` maps a global row
+    to its padded address — the host uses it to scatter seeded factors in
+    and gather trained factors out.
     """
-    self_idx: np.ndarray
-    other_idx: np.ndarray
-    rating: np.ndarray
-    counts: np.ndarray
-    rows_dev: int       # rows (users or items) per device, padded
-    nnz_dev: int        # ratings per device, padded
-    n_rows_pad: int     # rows_dev * n_dev
+    self_idx: np.ndarray     # (n_dev * nnz_dev,) int32, block-local
+    other_idx: np.ndarray    # (n_dev * nnz_dev,) int32, padded-address space
+    rating: np.ndarray       # (n_dev * nnz_dev,) float32, 0 in padding
+    counts: np.ndarray       # (n_dev * rows_dev,) int32 per padded row slot
+    pos: np.ndarray          # (n_self,) global row -> padded address
+    nnz_per_dev: np.ndarray  # (n_dev,) real ratings per device (balance diag)
+    rows_dev: int            # row slots per device
+    nnz_dev: int             # padded ratings per device
+    n_rows_pad: int          # rows_dev * n_dev
 
 
 def _shard_side(side: COOSide, n_dev: int, chunk: int) -> ShardedSide:
-    rows_dev = -(-side.n_self // n_dev)          # ceil
+    row_counts = np.asarray(side.counts)
+    n_self = side.n_self
+    rows_dev = max(-(-n_self // n_dev), 1)      # ceil
     n_rows_pad = rows_dev * n_dev
-    # ratings are sorted by self_idx; block boundaries via searchsorted
+
+    # Serpentine deal: row with the k-th largest nnz goes to device
+    # (k % n_dev) on even rounds, mirrored on odd rounds, at local slot
+    # (k // n_dev). Rows per device are exact; nnz per device is balanced
+    # to within one hot row even under power-law skew.
+    order = np.argsort(-row_counts, kind="stable")
+    k = np.arange(n_self)
+    rnd, slot = np.divmod(k, n_dev)
+    dev_seq = np.where(rnd % 2 == 0, slot, n_dev - 1 - slot)
+    pos = np.empty(n_self, dtype=np.int32)
+    pos[order] = (dev_seq * rows_dev + rnd).astype(np.int32)
+
+    # Regroup the (already self-sorted) real entries by padded address:
+    # the address is device-major, so one pack-sort both groups by device
+    # and sorts by local row within each device (gram_rhs precondition).
+    nnz_real = int(row_counts.sum())
+    key = pos[np.asarray(side.self_idx)[:nnz_real]]
+    packed = (key.astype(np.int64) << 32) | np.arange(nnz_real, dtype=np.int64)
+    packed.sort()
+    grouped_key = (packed >> 32).astype(np.int32)
+    order2 = (packed & 0xFFFFFFFF).astype(np.int64)
+    g_other = np.asarray(side.other_idx)[:nnz_real][order2]
+    g_rating = np.asarray(side.rating)[:nnz_real][order2]
+
     bounds = np.searchsorted(
-        side.self_idx, np.arange(0, n_rows_pad + 1, rows_dev))
-    nnz_dev = int(max((bounds[1:] - bounds[:-1]).max(), 1))
+        grouped_key, np.arange(0, n_rows_pad + 1, rows_dev))
+    nnz_per_dev = (bounds[1:] - bounds[:-1]).astype(np.int64)
+    nnz_dev = int(max(nnz_per_dev.max(), 1))
     nnz_dev = ((nnz_dev + chunk - 1) // chunk) * chunk
-    s = np.full((n_dev, nnz_dev), rows_dev, dtype=np.int32)  # pad = local n_self
+
+    s = np.full((n_dev, nnz_dev), rows_dev, dtype=np.int32)  # pad = dummy row
     o = np.zeros((n_dev, nnz_dev), dtype=np.int32)
     r = np.zeros((n_dev, nnz_dev), dtype=np.float32)
+    counts = np.zeros(n_rows_pad, dtype=np.int32)
+    counts[pos] = row_counts
     for d in range(n_dev):
         lo, hi = bounds[d], bounds[d + 1]
         m = hi - lo
-        s[d, :m] = side.self_idx[lo:hi] - d * rows_dev
-        o[d, :m] = side.other_idx[lo:hi]
-        r[d, :m] = side.rating[lo:hi]
-    counts = np.zeros(n_rows_pad, dtype=np.int32)
-    counts[: side.n_self] = side.counts
+        s[d, :m] = grouped_key[lo:hi] - d * rows_dev
+        o[d, :m] = g_other[lo:hi]
+        r[d, :m] = g_rating[lo:hi]
     return ShardedSide(
         self_idx=s.reshape(-1), other_idx=o.reshape(-1), rating=r.reshape(-1),
-        counts=counts, rows_dev=rows_dev, nnz_dev=nnz_dev,
-        n_rows_pad=n_rows_pad,
+        counts=counts, pos=pos, nnz_per_dev=nnz_per_dev, rows_dev=rows_dev,
+        nnz_dev=nnz_dev, n_rows_pad=n_rows_pad,
     )
 
 
 def prepare_sharded(data: ALSData, n_dev: int,
                     chunk: int = 1 << 16) -> Tuple[ShardedSide, ShardedSide]:
-    return (_shard_side(data.by_user, n_dev, chunk),
-            _shard_side(data.by_item, n_dev, chunk))
+    """Shard both orientations and cross-remap other-side indices into the
+    opposite side's padded address space."""
+    su = _shard_side(data.by_user, n_dev, chunk)
+    si = _shard_side(data.by_item, n_dev, chunk)
+    # user-sorted entries reference item rows -> item padded addresses, and
+    # vice versa. Padding entries carry other_idx 0 whose remap is a real
+    # address, but their weights are 0 so the gathered row never contributes.
+    su.other_idx = si.pos[su.other_idx]
+    si.other_idx = su.pos[si.other_idx]
+    return su, si
+
+
+def _pad_factors(F: np.ndarray, side: ShardedSide) -> np.ndarray:
+    out = np.zeros((side.n_rows_pad, F.shape[1]), dtype=np.float32)
+    out[side.pos] = np.asarray(F, dtype=np.float32)
+    return out
+
+
+def _train_sharded(
+    mesh: Mesh,
+    data: ALSData,
+    rank: int,
+    iterations: int,
+    lambda_: float,
+    seed: int,
+    chunk: int,
+    reg_scaling: str,
+    implicit: bool,
+    alpha: float,
+    u0,
+    v0,
+    checkpoint_every: Optional[int],
+    checkpointer,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    su, si = prepare_sharded(data, n_dev, chunk)
+    half = _half_step_implicit if implicit else _half_step_explicit
+
+    def step_fn(us, uo, ur, uc, is_, io, ir, ic, U0_blk, V0_blk, n_iters):
+        # Everything below runs per-device on (nnz_dev,) local slices.
+        U = lax.all_gather(U0_blk, axis, tiled=True)
+        V = lax.all_gather(V0_blk, axis, tiled=True)
+
+        def one_iter(_, UV):
+            U, V = UV
+            if implicit:
+                U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_, alpha,
+                             chunk=chunk, reg_scaling=reg_scaling)
+            else:
+                U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_,
+                             chunk=chunk, reg_scaling=reg_scaling)
+            U = lax.all_gather(U_blk, axis, tiled=True)
+            if implicit:
+                V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_, alpha,
+                             chunk=chunk, reg_scaling=reg_scaling)
+            else:
+                V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_,
+                             chunk=chunk, reg_scaling=reg_scaling)
+            V = lax.all_gather(V_blk, axis, tiled=True)
+            return (U, V)
+
+        U, V = lax.fori_loop(0, n_iters, one_iter, (U, V))
+        # return row-sharded blocks: slice this device's rows back out
+        idx = lax.axis_index(axis)
+        U_blk = lax.dynamic_slice_in_dim(U, idx * su.rows_dev, su.rows_dev)
+        V_blk = lax.dynamic_slice_in_dim(V, idx * si.rows_dev, si.rows_dev)
+        return U_blk, V_blk
+
+    sharded = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis), P(axis), P(axis),
+                  P(axis, None), P(axis, None), P()),
+        out_specs=(P(axis, None), P(axis, None)),
+        check_vma=False,
+    )
+    jitted = jax.jit(sharded)
+
+    flat_spec = NamedSharding(mesh, P(axis))
+    row_spec = NamedSharding(mesh, P(axis, None))
+    flat = tuple(
+        jax.device_put(a, flat_spec)
+        for a in (su.self_idx, su.other_idx, su.rating, su.counts,
+                  si.self_idx, si.other_idx, si.rating, si.counts))
+
+    if u0 is None or v0 is None:
+        u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
+
+    def run(u, v, n_iters):
+        U0 = jax.device_put(_pad_factors(np.asarray(u), su), row_spec)
+        V0 = jax.device_put(_pad_factors(np.asarray(v), si), row_spec)
+        U_pad, V_pad = jitted(*flat, U0, V0, jnp.int32(n_iters))
+        # gather padded blocks back to canonical row order
+        return (jnp.asarray(U_pad)[su.pos], jnp.asarray(V_pad)[si.pos])
+
+    return _run_segmented(run, u0, v0, iterations, checkpoint_every,
+                          checkpointer)
 
 
 def train_explicit_sharded(
@@ -87,68 +233,42 @@ def train_explicit_sharded(
     seed: int = 3,
     chunk: int = 1 << 16,
     reg_scaling: str = "count",
-    implicit: bool = False,
-    alpha: float = 1.0,
+    u0=None,
+    v0=None,
+    checkpoint_every: Optional[int] = None,
+    checkpointer=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Full training step sharded over `mesh`'s single axis.
+    """ALS.train over `mesh`'s single axis, nnz-balanced blocks.
 
-    Returns (U (n_users_pad, rank), V (n_items_pad, rank)) laid out
-    row-sharded over the mesh; slice [:n_users]/[:n_items] on host if the
-    padding rows matter.
+    Returns canonical (n_users, rank) / (n_items, rank) factors — no
+    caller-side unpadding. Checkpoint semantics and snapshot format match
+    ops.als.train_explicit exactly (shared `_run_segmented`), so a run can
+    move between the single-device and sharded paths across restores.
     """
-    axis = mesh.axis_names[0]
-    n_dev = mesh.devices.size
-    su, si = prepare_sharded(data, n_dev, chunk)
-    half = _half_step_implicit if implicit else _half_step_explicit
+    return _train_sharded(
+        mesh, data, rank, iterations, lambda_, seed, chunk, reg_scaling,
+        implicit=False, alpha=0.0, u0=u0, v0=v0,
+        checkpoint_every=checkpoint_every, checkpointer=checkpointer)
 
-    def half_kwargs():
-        return dict(chunk=chunk, reg_scaling=reg_scaling)
 
-    def step_fn(us, uo, ur, uc, is_, io, ir, ic, ku, ki):
-        # Everything below runs per-device on (nnz_dev,) local slices.
-        dev = lax.axis_index(axis)
-        U_blk = init_factors(jax.random.fold_in(ku, dev), su.rows_dev, rank)
-        U = lax.all_gather(U_blk, axis, tiled=True)
-        V_blk = init_factors(jax.random.fold_in(ki, dev), si.rows_dev, rank)
-        V = lax.all_gather(V_blk, axis, tiled=True)
-
-        def one_iter(_, UV):
-            U, V = UV
-            if implicit:
-                U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_, alpha,
-                             **half_kwargs())
-            else:
-                U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_,
-                             **half_kwargs())
-            U = lax.all_gather(U_blk, axis, tiled=True)
-            if implicit:
-                V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_, alpha,
-                             **half_kwargs())
-            else:
-                V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_,
-                             **half_kwargs())
-            V = lax.all_gather(V_blk, axis, tiled=True)
-            return (U, V)
-
-        U, V = lax.fori_loop(0, iterations, one_iter, (U, V))
-        # return row-sharded blocks: slice this device's rows back out
-        idx = lax.axis_index(axis)
-        U_blk = lax.dynamic_slice_in_dim(U, idx * su.rows_dev, su.rows_dev)
-        V_blk = lax.dynamic_slice_in_dim(V, idx * si.rows_dev, si.rows_dev)
-        return U_blk, V_blk
-
-    sharded = jax.shard_map(
-        step_fn, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis),
-                  P(axis), P(axis), P(axis), P(axis), P(), P()),
-        out_specs=(P(axis, None), P(axis, None)),
-        check_vma=False,
-    )
-
-    jitted = jax.jit(sharded)
-    ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-    args = (su.self_idx, su.other_idx, su.rating, su.counts,
-            si.self_idx, si.other_idx, si.rating, si.counts)
-    spec = NamedSharding(mesh, P(axis))
-    args = tuple(jax.device_put(a, spec) for a in args)
-    return jitted(*args, ku, ki)
+def train_implicit_sharded(
+    mesh: Mesh,
+    data: ALSData,
+    rank: int = 10,
+    iterations: int = 10,
+    lambda_: float = 0.01,
+    alpha: float = 1.0,
+    seed: int = 3,
+    chunk: int = 1 << 16,
+    reg_scaling: str = "count",
+    u0=None,
+    v0=None,
+    checkpoint_every: Optional[int] = None,
+    checkpointer=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """ALS.trainImplicit (Hu-Koren-Volinsky) over the mesh; see
+    train_explicit_sharded for layout/checkpoint semantics."""
+    return _train_sharded(
+        mesh, data, rank, iterations, lambda_, seed, chunk, reg_scaling,
+        implicit=True, alpha=alpha, u0=u0, v0=v0,
+        checkpoint_every=checkpoint_every, checkpointer=checkpointer)

@@ -9,7 +9,7 @@ host-side padding/partitioning for block-sharded kernels.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional
 
 import jax
 import numpy as np
@@ -45,37 +45,3 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, pad_value) -> np.ndarray:
         return arr
     pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
     return np.pad(arr, pad_width, constant_values=pad_value)
-
-
-def shard_rows(
-    sizes: Sequence[int], n_shards: int
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Partition `len(sizes)` contiguous row-groups into n_shards contiguous
-    blocks, balancing total size greedily.
-
-    Returns (block_start, block_end) index arrays of length n_shards over the
-    group axis. Used to split sorted-by-user ratings into per-device blocks.
-    """
-    sizes = np.asarray(sizes, dtype=np.int64)
-    n_groups = len(sizes)
-    total = int(sizes.sum())
-    target = total / max(n_shards, 1)
-    starts = np.zeros(n_shards, dtype=np.int64)
-    ends = np.zeros(n_shards, dtype=np.int64)
-    cum = np.concatenate([[0], np.cumsum(sizes)])
-    g = 0
-    for s in range(n_shards):
-        starts[s] = g
-        if s == n_shards - 1:
-            g = n_groups
-        else:
-            # advance until this shard's load reaches the even target
-            goal = (s + 1) * target
-            while g < n_groups and cum[g + 1] <= goal:
-                g += 1
-            # always make progress if groups remain and later shards can
-            # still be non-empty
-            if g == starts[s] and g < n_groups - (n_shards - s - 1):
-                g += 1
-        ends[s] = g
-    return starts, ends

@@ -43,8 +43,10 @@ def create(name: str, app_id: Optional[int] = None,
         raise CommandError(
             f"App ID {app_id} already exists and maps to the app "
             f"'{existing.name}'. Aborting.")
-    new_id = apps.insert(App(id=app_id or 0, name=name,
-                             description=description))
+    if app_id is not None and app_id <= 0:
+        raise CommandError(f"App ID {app_id} is invalid: must be positive.")
+    new_id = apps.insert(App(id=app_id if app_id is not None else 0,
+                             name=name, description=description))
     if new_id is None:
         raise CommandError("Unable to create new app.")
     if not events.init(new_id):

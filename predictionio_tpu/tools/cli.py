@@ -482,10 +482,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     try:
         return _DISPATCH[args.command](args)
-    except CommandError as e:
-        _error(str(e))
-        return 1
-    except FileNotFoundError as e:
+    except (CommandError, FileNotFoundError, ValueError) as e:
+        # operational failures (no COMPLETED instance for deploy, bad params,
+        # incompatible checkpoints, missing files) print the reference-style
+        # one-liner and exit 1; the traceback stays reachable under -v so a
+        # genuine library bug surfacing as ValueError is still debuggable
+        logging.getLogger(__name__).debug("command failed", exc_info=True)
         _error(str(e))
         return 1
 

@@ -6,14 +6,19 @@ built in tests span 8 virtual CPU devices.
 """
 
 import os
+import re
 
 # jax is preloaded by the environment's sitecustomize, so plain env vars are
 # too late — but the backend is not initialized yet, so config still applies.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+_m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None:
+    _flags += " --xla_force_host_platform_device_count=8"
+elif int(_m.group(1)) < 8:
+    _flags = _flags.replace(
+        _m.group(0), "--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = _flags.strip()
 
 import jax  # noqa: E402
 
