@@ -1,0 +1,26 @@
+# Daemon image for the TPU-native prediction server.
+# (reference: Dockerfile — which warns it is test-only; this one is the
+# real serving/ingestion image. TPU access requires the host's libtpu and
+# /dev/accel* mounted; CPU-only works out of the box for the event server,
+# storage server, dashboard and admin daemons.)
+FROM python:3.12-slim
+
+# native toolchain for the C++ data-layout kernels (optional at runtime;
+# the framework falls back to numpy when g++ is absent)
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/pio
+COPY pyproject.toml README.md ./
+COPY predictionio_tpu ./predictionio_tpu
+RUN pip install --no-cache-dir .
+
+ENV PIO_FS_BASEDIR=/var/lib/pio
+VOLUME /var/lib/pio
+
+# event server :7070, engine server :8000, dashboard :9000,
+# admin :7071, storage server :7072
+EXPOSE 7070 8000 9000 7071 7072
+
+ENTRYPOINT ["pio"]
+CMD ["eventserver", "--ip", "0.0.0.0", "--port", "7070"]

@@ -152,6 +152,12 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
         ctx.storage here instead of relying on the process-global
         singleton."""
 
+    def prepare_serving(self, model: M) -> M:
+        """Deploy-time hook run AFTER the model's arrays are device_put
+        (create_server.prepare_deploy): warm serving kernels, probe the
+        device, pick a serving layout. Default: serve the model as loaded."""
+        return model
+
     @property
     def query_class(self):
         """Optional override: the Query dataclass for JSON extraction."""

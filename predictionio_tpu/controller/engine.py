@@ -107,19 +107,22 @@ class Engine:
         params = ctx.workflow_params
         logger.info("EngineWorkflow.train")
 
-        td = data_source.read_training(ctx)
+        with ctx.phase("read"):
+            td = data_source.read_training(ctx)
         self._sanity_check(td, params)
         if params.stop_after_read:
             logger.info("Stopping after read (--stop-after-read)")
             raise StopAfterReadInterruption()
 
-        pd = preparator.prepare(ctx, td)
+        with ctx.phase("prepare"):
+            pd = preparator.prepare(ctx, td)
         self._sanity_check(pd, params)
         if params.stop_after_prepare:
             logger.info("Stopping after prepare (--stop-after-prepare)")
             raise StopAfterPrepareInterruption()
 
-        models = [a.train(ctx, pd) for a in algorithms]
+        with ctx.phase("train"):
+            models = [a.train(ctx, pd) for a in algorithms]
         for m in models:
             self._sanity_check(m, params)
         logger.info("EngineWorkflow.train completed")

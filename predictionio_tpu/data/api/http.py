@@ -18,6 +18,9 @@ from typing import Optional, Tuple
 class _Handler(BaseHTTPRequestHandler):
     api = None  # set by make_server
     protocol_version = "HTTP/1.1"
+    # serving-latency path: without this, Nagle + delayed-ACK adds ~40ms
+    # per small keep-alive response (CreateServer.scala p50 parity target)
+    disable_nagle_algorithm = True
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlsplit(self.path)

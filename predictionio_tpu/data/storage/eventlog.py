@@ -1,0 +1,680 @@
+"""Columnar append-only event log — the TPU-ingestion storage backend.
+
+The reference's scalable event store is HBase, designed around its read
+pattern: time-range scans deserializing one Event object per row
+(storage/hbase/.../HBEventsUtil.scala:84-131, HBPEvents.scala:63-88). A TPU
+framework's hot read is different: bulk-load EVERYTHING for an (app,
+channel) into columnar host buffers and `device_put` straight to HBM. This
+backend is an LSM-style log designed for that path:
+
+- inserts append to a **write-ahead log** (``wal.jsonl``, one JSON line per
+  event, written before the insert is acknowledged) and to an in-memory
+  buffer; at ``_FLUSH_AT`` events the buffer compacts into an immutable
+  **columnar chunk** (``chunk_<seq>.npz``): int32 dictionary codes for
+  every string field, int64 epoch-millis times, one float64 column (+ a
+  was-int flag column) per numeric scalar property, and a packed JSON
+  side-channel for everything else (non-numeric properties, tags, prId);
+- the string dictionary is per-(app, channel), append-only
+  (``dict.jsonl``); codes are stable across chunks so bulk reads
+  concatenate with ZERO decoding or remapping — `read_columns` returns
+  code arrays + the pool;
+- event IDs are ``<shard-token>-<chunk_seq>-<row>`` — O(1) lookup, zero
+  bytes stored; deletes are tombstones (``tombstones.json``).
+
+Concurrency: ONE writer process per (app, channel) — the Event Server —
+like the reference's region-server ownership. Readers are safe in any
+process at any time: every read refreshes the dictionary and WAL tails by
+file offset (chunks are immutable once written), so a deployed engine
+server sees the ingesting server's events, including unflushed ones.
+
+The generic `find` surface (full LEvents filter parity) is implemented with
+vectorized chunk filters and materializes Event objects only for matching
+rows, so the contract suite runs unmodified while the training path never
+touches a Python object per event.
+"""
+
+from __future__ import annotations
+
+import atexit
+import datetime as _dt
+import json
+import os
+import shutil
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import (
+    Events, event_matches,
+)
+
+_FLUSH_AT = 1 << 16  # buffered events per (app, channel) before compaction
+_MAX_EXACT_INT = 1 << 53  # beyond float64 exactness -> JSON side-channel
+
+
+class StorageClient:
+    """Directory holder (config PATH, default $PIO_FS_BASEDIR/eventlog)."""
+
+    def __init__(self, config):
+        path = config.properties.get("PATH")
+        if not path:
+            basedir = os.path.expanduser(
+                os.environ.get("PIO_FS_BASEDIR", "~/.pio_store"))
+            path = os.path.join(basedir, "eventlog")
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+
+def _millis(t: _dt.datetime) -> int:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1000)
+
+
+def _from_millis(ms: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
+
+
+def _is_exact_number(v) -> bool:
+    if isinstance(v, bool):
+        return False
+    if isinstance(v, int):
+        return abs(v) <= _MAX_EXACT_INT
+    return isinstance(v, float)
+
+
+class _Shard:
+    """State for one (app_id, channel_id): dict, WAL/buffer, chunk files."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.chunk_dir = os.path.join(root, "chunks")
+        os.makedirs(self.chunk_dir, exist_ok=True)
+        self.dict_path = os.path.join(root, "dict.jsonl")
+        self.wal_path = os.path.join(root, "wal.jsonl")
+        self.tomb_path = os.path.join(root, "tombstones.json")
+        self.pool: List[str] = []
+        self.codes: Dict[str, int] = {}
+        self.dict_offset = 0
+        self.refresh_dict()
+        self.tombstones = set()
+        if os.path.exists(self.tomb_path):
+            with open(self.tomb_path, encoding="utf-8") as f:
+                self.tombstones = set(json.load(f))
+        # per-shard token baked into event IDs so an ID from one (app,
+        # channel) never resolves in another (reference rowkeys embed a
+        # UUID, HBEventsUtil.scala:84-131)
+        token_path = os.path.join(root, "shard_id")
+        if os.path.exists(token_path):
+            with open(token_path, encoding="utf-8") as f:
+                self.token = f.read().strip()
+        else:
+            import uuid
+
+            self.token = uuid.uuid4().hex[:8]
+            with open(token_path, "w", encoding="utf-8") as f:
+                f.write(self.token)
+        seqs = self.chunk_seqs()
+        self.next_seq = max(seqs) + 1 if seqs else 0
+        self.buffer: List[Event] = []
+        self.wal_offset = 0
+        self.dirty = False  # True only after a LOCAL write (writer role)
+        self.refresh_wal()
+
+    # -- append-only file tailing (cross-process read-your-writes) ---------
+    def refresh_dict(self) -> None:
+        if not os.path.exists(self.dict_path):
+            return
+        size = os.path.getsize(self.dict_path)
+        if size == self.dict_offset:
+            return
+        with open(self.dict_path, encoding="utf-8") as f:
+            f.seek(self.dict_offset)
+            for line in f:
+                s = json.loads(line)
+                self.codes[s] = len(self.pool)
+                self.pool.append(s)
+            self.dict_offset = f.tell()
+
+    def refresh_wal(self) -> None:
+        """Tail the writer's WAL into our buffer view. The writer keeps
+        wal_offset == file size by construction, so this is a no-op for it;
+        a shrink means the writer compacted a chunk — rebuild from zero."""
+        size = (os.path.getsize(self.wal_path)
+                if os.path.exists(self.wal_path) else 0)
+        if size == self.wal_offset:
+            return
+        if size < self.wal_offset:
+            self.buffer = []
+            self.wal_offset = 0
+            # the compacted chunk is new to us too
+            seqs = self.chunk_seqs()
+            self.next_seq = max(seqs) + 1 if seqs else 0
+        with open(self.wal_path, encoding="utf-8") as f:
+            f.seek(self.wal_offset)
+            for line in f:
+                try:
+                    self.buffer.append(Event.from_dict(
+                        json.loads(line), validate=False))
+                except ValueError:
+                    continue  # torn tail write mid-crash
+            self.wal_offset = f.tell()
+
+    def append_wal(self, events: Sequence[Event]) -> None:
+        with open(self.wal_path, "a", encoding="utf-8") as f:
+            for e in events:
+                f.write(json.dumps(e.to_dict(with_event_id=False)) + "\n")
+            f.flush()
+            self.wal_offset = f.tell()
+
+    def truncate_wal(self) -> None:
+        open(self.wal_path, "w").close()
+        self.wal_offset = 0
+
+    def add_strings(self, strings: Sequence[str]) -> None:
+        new = []
+        seen = set()
+        for s in strings:
+            if s not in self.codes and s not in seen:
+                new.append(s)
+                seen.add(s)
+        if not new:
+            return
+        with open(self.dict_path, "a", encoding="utf-8") as f:
+            for s in new:
+                self.codes[s] = len(self.pool)
+                self.pool.append(s)
+                f.write(json.dumps(s) + "\n")
+            f.flush()
+            self.dict_offset = f.tell()
+
+    def save_tombstones(self) -> None:
+        with open(self.tomb_path, "w", encoding="utf-8") as f:
+            json.dump(sorted(self.tombstones), f)
+
+    def chunk_path(self, seq: int) -> str:
+        return os.path.join(self.chunk_dir, f"chunk_{seq}.npz")
+
+    def chunk_seqs(self) -> List[int]:
+        return sorted(
+            int(fn[len("chunk_"):-len(".npz")])
+            for fn in os.listdir(self.chunk_dir)
+            if fn.startswith("chunk_") and fn.endswith(".npz"))
+
+
+def _pack_extras(extras: List[str]) -> Tuple[str, np.ndarray]:
+    lengths = np.asarray([len(x) for x in extras], dtype=np.int32)
+    return "".join(extras), lengths
+
+
+class EventlogEvents(Events):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.client = client
+        self._shards: Dict[Tuple[int, Optional[int]], _Shard] = {}
+        self._lock = threading.RLock()
+        atexit.register(self.close)
+
+    # -- shard management ----------------------------------------------------
+    def _root(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = f"app_{app_id}" + (f"_{channel_id}" if channel_id else "")
+        return os.path.join(self.client.path, name)
+
+    def _shard(self, app_id: int, channel_id: Optional[int]) -> _Shard:
+        key = (app_id, channel_id)
+        with self._lock:
+            sh = self._shards.get(key)
+            if sh is None:
+                sh = _Shard(self._root(app_id, channel_id))
+                self._shards[key] = sh
+            return sh
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._shard(app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        key = (app_id, channel_id)
+        with self._lock:
+            self._shards.pop(key, None)
+            root = self._root(app_id, channel_id)
+            if os.path.isdir(root):
+                shutil.rmtree(root)
+                return True
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            for sh in self._shards.values():
+                self._flush_shard(sh)
+
+    # -- write path ----------------------------------------------------------
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        sh = self._shard(app_id, channel_id)
+        with self._lock:
+            # make every string durable in the dictionary up front (one
+            # append), so buffered events are encodable by any reader
+            strings: List[str] = []
+            for e in events:
+                strings.append(e.event)
+                strings.append(e.entity_type)
+                strings.append(e.entity_id)
+                if e.target_entity_type is not None:
+                    strings.append(e.target_entity_type)
+                if e.target_entity_id is not None:
+                    strings.append(e.target_entity_id)
+            sh.add_strings(strings)
+            sh.dirty = True
+            ids: List[str] = []
+            pending: List[Event] = []
+            for e in events:
+                ids.append(f"{sh.token}-{sh.next_seq}-{len(sh.buffer)}")
+                sh.buffer.append(e)
+                pending.append(e)
+                if len(sh.buffer) >= _FLUSH_AT:
+                    # the chunk itself makes these durable; pending WAL
+                    # lines for them are no longer needed
+                    self._flush_shard(sh)
+                    pending = []
+            if pending:
+                sh.append_wal(pending)
+            return ids
+
+    def flush(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        with self._lock:
+            self._flush_shard(self._shard(app_id, channel_id))
+
+    def _flush_shard(self, sh: _Shard) -> None:
+        """Compact the buffer into an immutable chunk. Writer-only: a pure
+        reader's buffer is a WAL tail owned by another process — compacting
+        it here would duplicate the writer's own eventual compaction."""
+        if not sh.buffer or not sh.dirty:
+            return
+        n = len(sh.buffer)
+        cols = {
+            "event": np.empty(n, np.int32),
+            "entity_type": np.empty(n, np.int32),
+            "entity_id": np.empty(n, np.int32),
+            "target_type": np.full(n, -1, np.int32),
+            "target_id": np.full(n, -1, np.int32),
+            "time_ms": np.empty(n, np.int64),
+            "creation_ms": np.empty(n, np.int64),
+        }
+        numeric: Dict[str, np.ndarray] = {}
+        was_int: Dict[str, np.ndarray] = {}
+        extras: List[str] = []
+
+        def code(s: str) -> int:
+            c = sh.codes.get(s)
+            if c is None:  # only reachable for recovered torn WALs
+                sh.add_strings([s])
+                c = sh.codes[s]
+            return c
+
+        for j, e in enumerate(sh.buffer):
+            cols["event"][j] = code(e.event)
+            cols["entity_type"][j] = code(e.entity_type)
+            cols["entity_id"][j] = code(e.entity_id)
+            if e.target_entity_type is not None:
+                cols["target_type"][j] = code(e.target_entity_type)
+            if e.target_entity_id is not None:
+                cols["target_id"][j] = code(e.target_entity_id)
+            cols["time_ms"][j] = _millis(e.event_time)
+            cols["creation_ms"][j] = _millis(e.creation_time)
+            extra: Dict[str, object] = {}
+            props = e.properties.to_dict() if e.properties else {}
+            rest = {}
+            for k, v in props.items():
+                if _is_exact_number(v):
+                    col = numeric.get(k)
+                    if col is None:
+                        col = numeric[k] = np.full(n, np.nan, np.float64)
+                        was_int[k] = np.zeros(n, np.uint8)
+                    col[j] = v
+                    was_int[k][j] = isinstance(v, int)
+                else:
+                    rest[k] = v
+            if rest:
+                extra["p"] = rest
+            if e.tags:
+                extra["t"] = list(e.tags)
+            if e.pr_id is not None:
+                extra["prid"] = e.pr_id
+            extras.append(json.dumps(extra) if extra else "")
+        blob, lengths = _pack_extras(extras)
+        out = dict(cols)
+        for k, v in numeric.items():
+            out["nc_" + k] = v
+            out["ni_" + k] = was_int[k]
+        out["extra_blob"] = np.asarray(blob)
+        out["extra_len"] = lengths
+        path = sh.chunk_path(sh.next_seq)
+        with open(path + ".tmp", "wb") as f:
+            np.savez(f, **out)
+        os.replace(path + ".tmp", path)
+        sh.buffer = []
+        sh.truncate_wal()
+        sh.next_seq += 1
+        sh.dirty = False
+
+    def append_encoded(
+        self,
+        app_id: int,
+        channel_id: Optional[int],
+        pool: Sequence[str],
+        event: np.ndarray,
+        entity_type: np.ndarray,
+        entity_id: np.ndarray,
+        time_ms: np.ndarray,
+        target_type: Optional[np.ndarray] = None,
+        target_id: Optional[np.ndarray] = None,
+        numeric: Optional[Dict[str, np.ndarray]] = None,
+    ) -> None:
+        """Bulk columnar append: code arrays must index `pool`, which must
+        extend the shard dictionary (i.e. come from a prior read_columns or
+        a fresh shard). The bulk twin of insert_batch for import pipelines
+        (reference PEvents.write, PEvents.scala:172-185)."""
+        sh = self._shard(app_id, channel_id)
+        with self._lock:
+            sh.dirty = True
+            self._flush_shard(sh)
+            pool = list(pool)
+            if pool[: len(sh.pool)] != sh.pool:
+                raise ValueError(
+                    "append_encoded pool is not an extension of the shard "
+                    "dictionary")
+            sh.add_strings(pool[len(sh.pool):])
+            n = len(event)
+            out = {
+                "event": np.asarray(event, np.int32),
+                "entity_type": np.asarray(entity_type, np.int32),
+                "entity_id": np.asarray(entity_id, np.int32),
+                "target_type": (np.asarray(target_type, np.int32)
+                                if target_type is not None
+                                else np.full(n, -1, np.int32)),
+                "target_id": (np.asarray(target_id, np.int32)
+                              if target_id is not None
+                              else np.full(n, -1, np.int32)),
+                "time_ms": np.asarray(time_ms, np.int64),
+                "creation_ms": np.asarray(time_ms, np.int64),
+                "extra_blob": np.asarray(""),
+                "extra_len": np.zeros(n, np.int32),
+            }
+            for k, v in (numeric or {}).items():
+                out["nc_" + k] = np.asarray(v, np.float64)
+                out["ni_" + k] = np.zeros(n, np.uint8)
+            path = sh.chunk_path(sh.next_seq)
+            with open(path + ".tmp", "wb") as f:
+                np.savez(f, **out)
+            os.replace(path + ".tmp", path)
+            sh.next_seq += 1
+            sh.dirty = False
+
+    # -- point reads ---------------------------------------------------------
+    def _materialize(self, sh: _Shard, seq: int, data, row: int,
+                     offsets: Optional[np.ndarray] = None) -> Event:
+        pool = sh.pool
+        tt = int(data["target_type"][row])
+        ti = int(data["target_id"][row])
+        lengths = data["extra_len"]
+        if offsets is None:
+            offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+        blob = str(data["extra_blob"])
+        raw = blob[offsets[row]: offsets[row] + lengths[row]]
+        extra = json.loads(raw) if raw else {}
+        props = dict(extra.get("p", {}))
+        for name in data.files:
+            if name.startswith("nc_"):
+                v = float(data[name][row])
+                if not np.isnan(v):
+                    flag_col = "ni_" + name[3:]
+                    is_int = (flag_col in data.files
+                              and bool(data[flag_col][row]))
+                    props[name[3:]] = int(v) if is_int else v
+        return Event(
+            event=pool[int(data["event"][row])],
+            entity_type=pool[int(data["entity_type"][row])],
+            entity_id=pool[int(data["entity_id"][row])],
+            event_id=f"{sh.token}-{seq}-{row}",
+            target_entity_type=pool[tt] if tt >= 0 else None,
+            target_entity_id=pool[ti] if ti >= 0 else None,
+            properties=DataMap(props),
+            event_time=_from_millis(int(data["time_ms"][row])),
+            tags=tuple(extra.get("t", ())),
+            pr_id=extra.get("prid"),
+            creation_time=_from_millis(int(data["creation_ms"][row])),
+        )
+
+    @staticmethod
+    def _parse_id(sh: _Shard, event_id: str) -> Optional[Tuple[int, int]]:
+        try:
+            token, seq_s, row_s = event_id.split("-", 2)
+            if token != sh.token:
+                return None
+            return int(seq_s), int(row_s)
+        except ValueError:
+            return None
+
+    def _refresh(self, sh: _Shard) -> None:
+        sh.refresh_dict()
+        sh.refresh_wal()
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        sh = self._shard(app_id, channel_id)
+        with self._lock:
+            self._refresh(sh)
+            if event_id in sh.tombstones:
+                return None
+            parsed = self._parse_id(sh, event_id)
+            if parsed is None:
+                return None
+            seq, row = parsed
+            if seq == sh.next_seq and row < len(sh.buffer):
+                return sh.buffer[row].with_event_id(event_id)
+            path = sh.chunk_path(seq)
+            if not os.path.exists(path):
+                return None
+            with np.load(path, allow_pickle=False) as data:
+                if row >= data["event"].shape[0]:
+                    return None
+                return self._materialize(sh, seq, data, row)
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        sh = self._shard(app_id, channel_id)
+        with self._lock:
+            if self.get(event_id, app_id, channel_id) is None:
+                return False
+            sh.tombstones.add(event_id)
+            sh.save_tombstones()
+            return True
+
+    # -- query ---------------------------------------------------------------
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        with self._lock:
+            sh = self._shard(app_id, channel_id)
+            self._refresh(sh)
+            full_filter = dict(
+                start_time=start_time, until_time=until_time,
+                entity_type=entity_type, entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id)
+            matches: List[Event] = []
+            for seq in sh.chunk_seqs():
+                with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
+                    mask = np.ones(data["event"].shape[0], dtype=bool)
+                    if start_time is not None:
+                        mask &= data["time_ms"] >= _millis(start_time)
+                    if until_time is not None:
+                        mask &= data["time_ms"] < _millis(until_time)
+                    if event_names is not None:
+                        codes = [sh.codes[nm] for nm in event_names
+                                 if nm in sh.codes]
+                        mask &= np.isin(data["event"], codes)
+                    if entity_type is not None:
+                        c = sh.codes.get(entity_type, -2)
+                        mask &= data["entity_type"] == c
+                    if entity_id is not None:
+                        c = sh.codes.get(entity_id, -2)
+                        mask &= data["entity_id"] == c
+                    offsets = np.concatenate(
+                        [[0], np.cumsum(data["extra_len"])[:-1]])
+                    for e in (self._materialize(sh, seq, data, int(row),
+                                                offsets)
+                              for row in np.nonzero(mask)[0]):
+                        # residual filters (target Some(None) semantics)
+                        # via the shared reference matcher
+                        if e.event_id in sh.tombstones:
+                            continue
+                        if event_matches(
+                                e, target_entity_type=target_entity_type,
+                                target_entity_id=target_entity_id):
+                            matches.append(e)
+            for row, e in enumerate(sh.buffer):
+                eid = f"{sh.token}-{sh.next_seq}-{row}"
+                if eid in sh.tombstones:
+                    continue
+                if event_matches(e, **full_filter):
+                    matches.append(e.with_event_id(eid))
+            matches.sort(key=lambda e: e.event_time, reverse=reversed_)
+            if limit is not None and limit >= 0:
+                matches = matches[:limit]
+            return iter(matches)
+
+    # -- bulk columnar read (the TPU ingestion path) -------------------------
+    def read_columns(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        rating_property: str = "rating",
+    ) -> Dict[str, object]:
+        """Bulk load matching events as code arrays + the string pool.
+
+        Returns dict with: pool (List[str]), entity_code, target_code,
+        event_code (int32 arrays), rating (float32, NaN where the property
+        is absent), time_ms (int64). No per-event Python objects for chunk
+        rows — this is the `PEventStore.find → HBM` path at full numpy
+        bandwidth. Unflushed (WAL) rows are encoded on the fly; string
+        ratings (client quirk, e.g. "4.5") are coerced from the JSON
+        side-channel exactly like the generic object path does.
+        """
+        with self._lock:
+            sh = self._shard(app_id, channel_id)
+            self._refresh(sh)
+            ent, tgt, evt, rat, tms = [], [], [], [], []
+            nc = "nc_" + rating_property
+            for seq in sh.chunk_seqs():
+                with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
+                    mask = np.ones(data["event"].shape[0], dtype=bool)
+                    if event_names is not None:
+                        codes = [sh.codes[nm] for nm in event_names
+                                 if nm in sh.codes]
+                        mask &= np.isin(data["event"], codes)
+                    if entity_type is not None:
+                        mask &= (data["entity_type"]
+                                 == sh.codes.get(entity_type, -2))
+                    if target_entity_type is not None:
+                        mask &= (data["target_type"]
+                                 == sh.codes.get(target_entity_type, -2))
+                    if sh.tombstones:
+                        parsed = (self._parse_id(sh, t)
+                                  for t in sh.tombstones)
+                        tomb_rows = [p[1] for p in parsed
+                                     if p is not None and p[0] == seq]
+                        if tomb_rows:
+                            mask[np.asarray(tomb_rows,
+                                            dtype=np.int64)] = False
+                    ent.append(data["entity_id"][mask])
+                    tgt.append(data["target_id"][mask])
+                    evt.append(data["event"][mask])
+                    tms.append(data["time_ms"][mask])
+                    if nc in data.files:
+                        r = data[nc][mask].astype(np.float32)
+                    else:
+                        r = np.full(int(mask.sum()), np.nan, np.float32)
+                    # string-typed ratings live in the JSON side-channel;
+                    # coerce them like the object path's float() (bounded
+                    # by how many rows are actually dirty)
+                    dirty = np.isnan(r) & (data["extra_len"][mask] > 0)
+                    if dirty.any():
+                        lengths = data["extra_len"]
+                        offsets = np.concatenate(
+                            [[0], np.cumsum(lengths)[:-1]])
+                        blob = str(data["extra_blob"])
+                        rows = np.nonzero(mask)[0][dirty]
+                        for out_ix, row in zip(np.nonzero(dirty)[0], rows):
+                            raw = blob[offsets[row]:
+                                       offsets[row] + lengths[row]]
+                            try:
+                                v = json.loads(raw).get("p", {}).get(
+                                    rating_property)
+                                if v is not None:
+                                    r[out_ix] = float(v)
+                            except (ValueError, TypeError):
+                                pass
+                    rat.append(r)
+            # unflushed rows (ours or the writer's WAL tail)
+            if sh.buffer:
+                for row, e in enumerate(sh.buffer):
+                    eid = f"{sh.token}-{sh.next_seq}-{row}"
+                    if eid in sh.tombstones:
+                        continue
+                    if event_names is not None and e.event not in event_names:
+                        continue
+                    if (entity_type is not None
+                            and e.entity_type != entity_type):
+                        continue
+                    if (target_entity_type is not None
+                            and e.target_entity_type != target_entity_type):
+                        continue
+                    ent.append(np.asarray(
+                        [sh.codes.get(e.entity_id, -1)], np.int32))
+                    tgt.append(np.asarray(
+                        [sh.codes.get(e.target_entity_id, -1)
+                         if e.target_entity_id is not None else -1],
+                        np.int32))
+                    evt.append(np.asarray(
+                        [sh.codes.get(e.event, -1)], np.int32))
+                    tms.append(np.asarray([_millis(e.event_time)], np.int64))
+                    v = e.properties.get_opt(rating_property)
+                    try:
+                        rat.append(np.asarray(
+                            [float(v) if v is not None else np.nan],
+                            np.float32))
+                    except (TypeError, ValueError):
+                        rat.append(np.asarray([np.nan], np.float32))
+            cat = (lambda xs, d: np.concatenate(xs) if xs
+                   else np.empty(0, dtype=d))
+            return {
+                "pool": list(sh.pool),
+                "entity_code": cat(ent, np.int32),
+                "target_code": cat(tgt, np.int32),
+                "event_code": cat(evt, np.int32),
+                "rating": cat(rat, np.float32),
+                "time_ms": cat(tms, np.int64),
+            }

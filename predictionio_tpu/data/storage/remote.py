@@ -1,0 +1,546 @@
+"""Networked storage backend: HTTP storage server + `remote` client driver.
+
+The reference's shared stores are networked databases — PostgreSQL
+(storage/jdbc/.../JDBCLEvents.scala:43-100), Elasticsearch, HBase — so any
+number of daemons and machines can read the same events/metadata/models.
+This module provides that role natively: a **storage server** daemon
+(`pio storageserver`, StorageRPCAPI below) exposes a full Storage — any
+local backend combination: sqlite, eventlog, localfs — over HTTP, and the
+`remote` backend type is the client driver implementing every DAO against
+it, discovered through the same env-var registry as every other backend:
+
+    PIO_STORAGE_SOURCES_PG_TYPE=remote
+    PIO_STORAGE_SOURCES_PG_URL=http://stores.internal:7072
+    PIO_STORAGE_SOURCES_PG_KEY=<shared secret>        # optional
+    PIO_STORAGE_REPOSITORIES_METADATA_SOURCE=PG ...
+
+Wire format: POST /rpc, JSON body {"dao", "method", "args"}; events use the
+Event Server's public JSON encoding (EventJson4sSupport parity), model
+blobs are base64, timestamps ISO-8601 UTC. Optional shared-key auth via
+the X-PIO-Storage-Key header (common/.../KeyAuthentication.scala role).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import (
+    AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
+    EngineInstances, EvaluationInstance, EvaluationInstances, Events, Model,
+    Models,
+)
+
+# --------------------------------------------------------------------------
+# codecs
+# --------------------------------------------------------------------------
+
+def _iso(t: Optional[_dt.datetime]) -> Optional[str]:
+    if t is None:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return t.isoformat()
+
+
+def _from_iso(s: Optional[str]) -> Optional[_dt.datetime]:
+    return _dt.datetime.fromisoformat(s) if s else None
+
+
+def _enc_engine_instance(i: EngineInstance) -> Dict[str, Any]:
+    d = dict(i.__dict__)
+    d["start_time"], d["end_time"] = _iso(i.start_time), _iso(i.end_time)
+    d["env"], d["runtime_conf"] = dict(i.env), dict(i.runtime_conf)
+    return d
+
+
+def _dec_engine_instance(d: Dict[str, Any]) -> EngineInstance:
+    d = dict(d)
+    d["start_time"] = _from_iso(d["start_time"])
+    d["end_time"] = _from_iso(d["end_time"])
+    return EngineInstance(**d)
+
+
+def _enc_evaluation_instance(i: EvaluationInstance) -> Dict[str, Any]:
+    d = dict(i.__dict__)
+    d["start_time"], d["end_time"] = _iso(i.start_time), _iso(i.end_time)
+    d["env"], d["runtime_conf"] = dict(i.env), dict(i.runtime_conf)
+    return d
+
+
+def _dec_evaluation_instance(d: Dict[str, Any]) -> EvaluationInstance:
+    d = dict(d)
+    d["start_time"] = _from_iso(d["start_time"])
+    d["end_time"] = _from_iso(d["end_time"])
+    return EvaluationInstance(**d)
+
+
+def _enc_event(e: Event) -> Dict[str, Any]:
+    return e.to_dict(with_event_id=True)
+
+
+def _dec_event(d: Dict[str, Any]) -> Event:
+    return Event.from_dict(d, validate=False)
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class StorageRPCAPI:
+    """Route handler exposing a Storage over /rpc (host with
+    data.api.http.make_server, same pattern as every other daemon)."""
+
+    def __init__(self, storage, key: Optional[str] = None):
+        self.storage = storage
+        self.key = key
+
+    # -- per-DAO method tables, each entry: args-dict -> JSON-able ----------
+    def _events(self, m: str, a: Dict[str, Any]):
+        ev = self.storage.get_events()
+        app, ch = a.get("app_id"), a.get("channel_id")
+        if m == "init":
+            return ev.init(app, ch)
+        if m == "remove":
+            return ev.remove(app, ch)
+        if m == "insert_batch":
+            return ev.insert_batch(
+                [_dec_event(d) for d in a["events"]], app, ch)
+        if m == "get":
+            got = ev.get(a["event_id"], app, ch)
+            return None if got is None else _enc_event(got)
+        if m == "delete":
+            return ev.delete(a["event_id"], app, ch)
+        if m == "find":
+            events = ev.find(
+                app_id=app, channel_id=ch,
+                start_time=_from_iso(a.get("start_time")),
+                until_time=_from_iso(a.get("until_time")),
+                entity_type=a.get("entity_type"),
+                entity_id=a.get("entity_id"),
+                event_names=a.get("event_names"),
+                target_entity_type=a.get("target_entity_type"),
+                target_entity_id=a.get("target_entity_id"),
+                limit=a.get("limit"),
+                reversed_=a.get("reversed", False))
+            return [_enc_event(e) for e in events]
+        raise ValueError(f"unknown events method {m!r}")
+
+    def _apps(self, m: str, a: Dict[str, Any]):
+        dao = self.storage.get_meta_data_apps()
+        if m == "insert":
+            return dao.insert(App(**a["app"]))
+        if m == "get":
+            got = dao.get(a["app_id"])
+            return got and dict(got.__dict__)
+        if m == "get_by_name":
+            got = dao.get_by_name(a["name"])
+            return got and dict(got.__dict__)
+        if m == "get_all":
+            return [dict(x.__dict__) for x in dao.get_all()]
+        if m == "update":
+            return dao.update(App(**a["app"]))
+        if m == "delete":
+            return dao.delete(a["app_id"])
+        raise ValueError(f"unknown apps method {m!r}")
+
+    def _access_keys(self, m: str, a: Dict[str, Any]):
+        dao = self.storage.get_meta_data_access_keys()
+        if m == "insert":
+            return dao.insert(AccessKey(**a["k"]))
+        if m == "get":
+            got = dao.get(a["key"])
+            return got and {**got.__dict__, "events": list(got.events)}
+        if m == "get_all":
+            return [{**x.__dict__, "events": list(x.events)}
+                    for x in dao.get_all()]
+        if m == "get_by_appid":
+            return [{**x.__dict__, "events": list(x.events)}
+                    for x in dao.get_by_appid(a["appid"])]
+        if m == "update":
+            return dao.update(AccessKey(**a["k"]))
+        if m == "delete":
+            return dao.delete(a["key"])
+        raise ValueError(f"unknown access_keys method {m!r}")
+
+    def _channels(self, m: str, a: Dict[str, Any]):
+        dao = self.storage.get_meta_data_channels()
+        if m == "insert":
+            return dao.insert(Channel(**a["channel"]))
+        if m == "get":
+            got = dao.get(a["channel_id"])
+            return got and dict(got.__dict__)
+        if m == "get_by_appid":
+            return [dict(x.__dict__) for x in dao.get_by_appid(a["appid"])]
+        if m == "delete":
+            return dao.delete(a["channel_id"])
+        raise ValueError(f"unknown channels method {m!r}")
+
+    def _engine_instances(self, m: str, a: Dict[str, Any]):
+        dao = self.storage.get_meta_data_engine_instances()
+        if m == "insert":
+            return dao.insert(_dec_engine_instance(a["i"]))
+        if m == "get":
+            got = dao.get(a["instance_id"])
+            return got and _enc_engine_instance(got)
+        if m == "get_all":
+            return [_enc_engine_instance(x) for x in dao.get_all()]
+        if m == "get_latest_completed":
+            got = dao.get_latest_completed(
+                a["engine_id"], a["engine_version"], a["engine_variant"])
+            return got and _enc_engine_instance(got)
+        if m == "get_completed":
+            return [_enc_engine_instance(x) for x in dao.get_completed(
+                a["engine_id"], a["engine_version"], a["engine_variant"])]
+        if m == "update":
+            return dao.update(_dec_engine_instance(a["i"]))
+        if m == "delete":
+            return dao.delete(a["instance_id"])
+        raise ValueError(f"unknown engine_instances method {m!r}")
+
+    def _evaluation_instances(self, m: str, a: Dict[str, Any]):
+        dao = self.storage.get_meta_data_evaluation_instances()
+        if m == "insert":
+            return dao.insert(_dec_evaluation_instance(a["i"]))
+        if m == "get":
+            got = dao.get(a["instance_id"])
+            return got and _enc_evaluation_instance(got)
+        if m == "get_all":
+            return [_enc_evaluation_instance(x) for x in dao.get_all()]
+        if m == "get_completed":
+            return [_enc_evaluation_instance(x) for x in dao.get_completed()]
+        if m == "update":
+            return dao.update(_dec_evaluation_instance(a["i"]))
+        if m == "delete":
+            return dao.delete(a["instance_id"])
+        raise ValueError(f"unknown evaluation_instances method {m!r}")
+
+    def _models(self, m: str, a: Dict[str, Any]):
+        dao = self.storage.get_model_data_models()
+        if m == "insert":
+            return dao.insert(Model(
+                id=a["id"], models=base64.b64decode(a["models"])))
+        if m == "get":
+            got = dao.get(a["model_id"])
+            return got and {"id": got.id,
+                            "models": base64.b64encode(got.models).decode()}
+        if m == "delete":
+            return dao.delete(a["model_id"])
+        raise ValueError(f"unknown models method {m!r}")
+
+    _DAOS = {
+        "events": _events, "apps": _apps, "access_keys": _access_keys,
+        "channels": _channels, "engine_instances": _engine_instances,
+        "evaluation_instances": _evaluation_instances, "models": _models,
+    }
+
+    def handle(self, method: str, path: str,
+               query: Optional[Dict[str, str]] = None,
+               body: bytes = b"",
+               headers: Optional[Dict[str, str]] = None):
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        if self.key and headers.get("x-pio-storage-key") != self.key:
+            return 401, {"message": "invalid storage key"}
+        if method == "GET" and path == "/":
+            return 200, {"status": "alive"}
+        if method != "POST" or path != "/rpc":
+            return 404, {"message": f"unknown route {method} {path}"}
+        try:
+            req = json.loads(body.decode("utf-8"))
+            dao_fn = self._DAOS.get(req.get("dao"))
+            if dao_fn is None:
+                return 400, {"message": f"unknown dao {req.get('dao')!r}"}
+            result = dao_fn(self, req.get("method", ""),
+                            req.get("args") or {})
+            return 200, {"result": result}
+        except (ValueError, KeyError, TypeError) as e:
+            return 400, {"message": f"{type(e).__name__}: {e}"}
+        except Exception as e:  # pragma: no cover - backend failure
+            return 500, {"message": f"{type(e).__name__}: {e}"}
+
+
+# --------------------------------------------------------------------------
+# client driver
+# --------------------------------------------------------------------------
+
+class StorageClient:
+    """props: URL (http://host:port) [+ KEY, TIMEOUT]."""
+
+    def __init__(self, config):
+        url = config.properties.get("URL", "http://localhost:7072")
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        self.host, _, port = url.partition(":")
+        self.port = int(port.rstrip("/") or 7072)
+        self.key = config.properties.get("KEY")
+        self.timeout = float(config.properties.get("TIMEOUT", "30"))
+        self._local = threading.local()
+
+    def _conn(self):
+        import http.client
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    #: methods safe to replay after a dropped keep-alive connection; writes
+    #: are NEVER transparently retried (the server may already have applied
+    #: them — a replayed insert_batch would double-store every event)
+    _IDEMPOTENT = frozenset({
+        "get", "get_by_name", "get_all", "get_by_appid",
+        "get_latest_completed", "get_completed", "find", "init",
+    })
+
+    def call(self, dao: str, method: str, **args) -> Any:
+        payload = json.dumps(
+            {"dao": dao, "method": method, "args": args}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.key:
+            headers["X-PIO-Storage-Key"] = self.key
+        retries = (0, 1) if method in self._IDEMPOTENT else (0,)
+        for attempt in retries:
+            conn = self._conn()
+            try:
+                conn.request("POST", "/rpc", body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (ConnectionError, OSError):
+                self._local.conn = None
+                if attempt == retries[-1]:
+                    raise
+        out = json.loads(data.decode("utf-8"))
+        if resp.status != 200:
+            raise RuntimeError(
+                f"storage server error {resp.status}: "
+                f"{out.get('message', '')}")
+        return out.get("result")
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+class RemoteEvents(Events):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    def init(self, app_id, channel_id=None) -> bool:
+        return bool(self.c.call("events", "init", app_id=app_id,
+                                channel_id=channel_id))
+
+    def remove(self, app_id, channel_id=None) -> bool:
+        return bool(self.c.call("events", "remove", app_id=app_id,
+                                channel_id=channel_id))
+
+    def close(self) -> None:
+        self.c.close()
+
+    def insert(self, event, app_id, channel_id=None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+        return self.c.call(
+            "events", "insert_batch", app_id=app_id, channel_id=channel_id,
+            events=[_enc_event(e) for e in events])
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        d = self.c.call("events", "get", event_id=event_id, app_id=app_id,
+                        channel_id=channel_id)
+        return None if d is None else _dec_event(d)
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        return bool(self.c.call("events", "delete", event_id=event_id,
+                                app_id=app_id, channel_id=channel_id))
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None, limit=None,
+             reversed_=False) -> Iterator[Event]:
+        rows = self.c.call(
+            "events", "find", app_id=app_id, channel_id=channel_id,
+            start_time=_iso(start_time), until_time=_iso(until_time),
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=list(event_names) if event_names else None,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit,
+            reversed=reversed_)
+        return iter([_dec_event(d) for d in rows])
+
+
+class RemoteApps(Apps):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    def insert(self, app: App) -> Optional[int]:
+        return self.c.call("apps", "insert", app=dict(app.__dict__))
+
+    def get(self, app_id: int) -> Optional[App]:
+        d = self.c.call("apps", "get", app_id=app_id)
+        return App(**d) if d else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        d = self.c.call("apps", "get_by_name", name=name)
+        return App(**d) if d else None
+
+    def get_all(self) -> List[App]:
+        return [App(**d) for d in self.c.call("apps", "get_all")]
+
+    def update(self, app: App) -> None:
+        self.c.call("apps", "update", app=dict(app.__dict__))
+
+    def delete(self, app_id: int) -> None:
+        self.c.call("apps", "delete", app_id=app_id)
+
+
+class RemoteAccessKeys(AccessKeys):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    @staticmethod
+    def _dec(d):
+        return AccessKey(key=d["key"], appid=d["appid"],
+                         events=tuple(d.get("events") or ()))
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        return self.c.call("access_keys", "insert",
+                           k={**k.__dict__, "events": list(k.events)})
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        d = self.c.call("access_keys", "get", key=key)
+        return self._dec(d) if d else None
+
+    def get_all(self) -> List[AccessKey]:
+        return [self._dec(d) for d in self.c.call("access_keys", "get_all")]
+
+    def get_by_appid(self, appid: int) -> List[AccessKey]:
+        return [self._dec(d) for d in
+                self.c.call("access_keys", "get_by_appid", appid=appid)]
+
+    def update(self, k: AccessKey) -> None:
+        self.c.call("access_keys", "update",
+                    k={**k.__dict__, "events": list(k.events)})
+
+    def delete(self, key: str) -> None:
+        self.c.call("access_keys", "delete", key=key)
+
+
+class RemoteChannels(Channels):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        return self.c.call("channels", "insert",
+                           channel=dict(channel.__dict__))
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        d = self.c.call("channels", "get", channel_id=channel_id)
+        return Channel(**d) if d else None
+
+    def get_by_appid(self, appid: int) -> List[Channel]:
+        return [Channel(**d) for d in
+                self.c.call("channels", "get_by_appid", appid=appid)]
+
+    def delete(self, channel_id: int) -> None:
+        self.c.call("channels", "delete", channel_id=channel_id)
+
+
+class RemoteEngineInstances(EngineInstances):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    def insert(self, i: EngineInstance) -> str:
+        return self.c.call("engine_instances", "insert",
+                           i=_enc_engine_instance(i))
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        d = self.c.call("engine_instances", "get", instance_id=instance_id)
+        return _dec_engine_instance(d) if d else None
+
+    def get_all(self) -> List[EngineInstance]:
+        return [_dec_engine_instance(d) for d in
+                self.c.call("engine_instances", "get_all")]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        d = self.c.call(
+            "engine_instances", "get_latest_completed", engine_id=engine_id,
+            engine_version=engine_version, engine_variant=engine_variant)
+        return _dec_engine_instance(d) if d else None
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return [_dec_engine_instance(d) for d in self.c.call(
+            "engine_instances", "get_completed", engine_id=engine_id,
+            engine_version=engine_version, engine_variant=engine_variant)]
+
+    def update(self, i: EngineInstance) -> None:
+        self.c.call("engine_instances", "update", i=_enc_engine_instance(i))
+
+    def delete(self, instance_id: str) -> None:
+        self.c.call("engine_instances", "delete", instance_id=instance_id)
+
+
+class RemoteEvaluationInstances(EvaluationInstances):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    def insert(self, i: EvaluationInstance) -> str:
+        return self.c.call("evaluation_instances", "insert",
+                           i=_enc_evaluation_instance(i))
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        d = self.c.call("evaluation_instances", "get",
+                        instance_id=instance_id)
+        return _dec_evaluation_instance(d) if d else None
+
+    def get_all(self) -> List[EvaluationInstance]:
+        return [_dec_evaluation_instance(d) for d in
+                self.c.call("evaluation_instances", "get_all")]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        return [_dec_evaluation_instance(d) for d in
+                self.c.call("evaluation_instances", "get_completed")]
+
+    def update(self, i: EvaluationInstance) -> None:
+        self.c.call("evaluation_instances", "update",
+                    i=_enc_evaluation_instance(i))
+
+    def delete(self, instance_id: str) -> None:
+        self.c.call("evaluation_instances", "delete",
+                    instance_id=instance_id)
+
+
+class RemoteModels(Models):
+    def __init__(self, client: StorageClient, config, namespace: str = ""):
+        self.c = client
+
+    def insert(self, m: Model) -> None:
+        self.c.call("models", "insert", id=m.id,
+                    models=base64.b64encode(m.models).decode())
+
+    def get(self, model_id: str) -> Optional[Model]:
+        d = self.c.call("models", "get", model_id=model_id)
+        if d is None:
+            return None
+        return Model(id=d["id"], models=base64.b64decode(d["models"]))
+
+    def delete(self, model_id: str) -> None:
+        self.c.call("models", "delete", model_id=model_id)
+
+
+def serve_storage(storage, host: str = "localhost", port: int = 7072,
+                  key: Optional[str] = None):
+    """Start (and return) the threaded storage server daemon."""
+    from predictionio_tpu.data.api.http import make_server
+
+    server = make_server(StorageRPCAPI(storage, key=key), host, port)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

@@ -152,6 +152,73 @@ class ColumnarEvents:
         return int(self.entity_idx.shape[0])
 
 
+def _columnar_from_codes(cols: Dict[str, object],
+                         event_names: Optional[Sequence[str]],
+                         entity_vocab: Optional[BiMap],
+                         target_vocab: Optional[BiMap]) -> ColumnarEvents:
+    """Vectorized dict-code → dense-vocab encode (zero per-event Python).
+
+    Vocab ids are assigned in dictionary-code order (≈ first-ingested order)
+    rather than the object path's first-matching-event order; downstream
+    kernels treat ids as opaque, so only the BiMap contents matter.
+    """
+    pool: List[str] = cols["pool"]  # type: ignore[assignment]
+    ecode = np.asarray(cols["entity_code"])
+    tcode = np.asarray(cols["target_code"])
+    ncode = np.asarray(cols["event_code"])
+    rating = np.asarray(cols["rating"])
+    tms = np.asarray(cols["time_ms"])
+
+    def dense(codes, vocab):
+        valid = codes >= 0  # -1 = event has no such entity (targets)
+        if vocab is None:
+            # presence via bincount + LUT gather: O(n + pool), no sort
+            present = np.bincount(
+                codes[valid], minlength=len(pool)).astype(bool)
+            used = np.nonzero(present)[0]
+            lut = np.full(len(pool), -1, np.int32)
+            lut[used] = np.arange(used.size, dtype=np.int32)
+            out_vocab = BiMap({pool[int(c)]: int(lut[c])
+                               for c in used.tolist()})
+            idx = np.where(valid, lut[np.maximum(codes, 0)],
+                           -1).astype(np.int32)
+            return idx, out_vocab, np.ones(codes.shape[0], dtype=bool)
+        lut = np.full(len(pool), -1, np.int32)
+        str2code = {s: c for c, s in enumerate(pool)}
+        for s, i in vocab.to_dict().items():
+            c = str2code.get(s)
+            if c is not None:
+                lut[c] = i
+        idx = np.where(valid, lut[np.maximum(codes, 0)], -1).astype(np.int32)
+        # fixed vocab: drop events referencing unseen (non-null) entities
+        keep = ~(valid & (idx < 0))
+        return idx, vocab, keep
+
+    e_idx, e_vocab, e_keep = dense(ecode, entity_vocab)
+    t_idx, t_vocab, t_keep = dense(tcode, target_vocab)
+    keep = e_keep & t_keep
+    if not keep.all():
+        e_idx, t_idx, ncode = e_idx[keep], t_idx[keep], ncode[keep]
+        rating, tms = rating[keep], tms[keep]
+
+    if event_names:
+        name_order = list(event_names)
+    else:
+        name_order = [pool[int(c)] for c in np.unique(ncode).tolist()]
+    name_lut = np.full(len(pool) + 1, -1, np.int32)
+    for i, n in enumerate(name_order):
+        try:
+            name_lut[pool.index(n)] = i
+        except ValueError:
+            pass
+    return ColumnarEvents(
+        entity_ids=e_vocab, target_ids=t_vocab, event_names=name_order,
+        entity_idx=e_idx, target_idx=t_idx,
+        event_name_idx=name_lut[ncode].astype(np.int32),
+        rating=rating.astype(np.float32), event_time_ms=tms.astype(np.int64),
+    )
+
+
 def find_columnar(
     app_name: str,
     channel_name: Optional[str] = None,
@@ -169,7 +236,22 @@ def find_columnar(
     (BiMap.scala:96-128) plus the per-template `.map`/`.filter` RDD chains:
     one host pass builds vocabularies and encoded COO arrays together.
     Pass pre-built vocabs to encode eval data consistently with training.
+
+    When the event store is the columnar event log
+    (data/storage/eventlog.py) the whole read runs vectorized over
+    dictionary codes — no Event objects, no JSON — at numpy bandwidth;
+    otherwise it falls back to the generic per-event path.
     """
+    storage = storage or get_storage()
+    events_dao = storage.get_events()
+    if hasattr(events_dao, "read_columns"):
+        app_id, channel_id = _resolve_app(app_name, channel_name, storage)
+        cols = events_dao.read_columns(
+            app_id, channel_id, event_names=event_names,
+            entity_type=entity_type, target_entity_type=target_entity_type,
+            rating_property=rating_property)
+        return _columnar_from_codes(cols, event_names, entity_vocab,
+                                    target_vocab)
     events = find(
         app_name, channel_name=channel_name, event_names=event_names,
         entity_type=entity_type, target_entity_type=target_entity_type,

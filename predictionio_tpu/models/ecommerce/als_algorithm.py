@@ -109,7 +109,7 @@ class ECommAlgorithm(Algorithm):
             np.random.SeedSequence().entropy % (2 ** 31))
         prepared = als.prepare_ratings(
             u_idx, i_idx, vals,
-            n_users=len(user_vocab), n_items=len(item_vocab))
+            n_users=len(user_vocab), n_items=len(item_vocab), device=True)
         U, V = als.train_explicit(
             prepared, rank=self.ap.rank, iterations=self.ap.numIterations,
             lambda_=self.ap.lambda_, seed=int(seed))

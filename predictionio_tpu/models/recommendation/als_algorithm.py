@@ -72,9 +72,19 @@ class ALSAlgorithm(Algorithm):
         # MLlib uses System.nanoTime when no seed given (ALSAlgorithm.scala:56)
         seed = self.ap.seed if self.ap.seed is not None else (
             np.random.SeedSequence().entropy % (2 ** 31))
-        data = als.prepare_ratings(
-            td.user_idx, td.item_idx, td.rating,
-            n_users=len(td.user_vocab), n_items=len(td.item_vocab))
+        use_mesh = ctx is not None and getattr(ctx, "mesh", None) is not None
+        if ctx is not None and hasattr(ctx, "phase"):
+            layout = ctx.phase("layout")
+        else:
+            import contextlib
+            layout = contextlib.nullcontext()
+        with layout:
+            data = als.prepare_ratings(
+                td.user_idx, td.item_idx, td.rating,
+                n_users=len(td.user_vocab), n_items=len(td.item_vocab),
+                # single-device: sort/pad in HBM; mesh path re-partitions
+                # on host
+                device=not use_mesh)
         checkpointer = None
         ckpt_dir = getattr(ctx, "checkpoint_dir", None)
         if self.ap.checkpointInterval and ckpt_dir:
@@ -96,9 +106,55 @@ class ALSAlgorithm(Algorithm):
                 lambda_=self.ap.lambda_, seed=int(seed),
                 checkpoint_every=self.ap.checkpointInterval,
                 checkpointer=checkpointer)
+        import jax
+
+        jax.block_until_ready((U, V))  # train phase owns its wall-clock
         return ALSModel(
             rank=self.ap.rank, user_factors=U, item_factors=V,
             user_vocab=td.user_vocab, item_vocab=td.item_vocab)
+
+    def prepare_serving(self, model: ALSModel) -> ALSModel:
+        """Pick the serving path by MEASURING the deployed device.
+
+        Device-resident serving (one fused dispatch per query,
+        topk.topk_for_user) wins on a locally-attached TPU; when the chip
+        is remote/tunneled or the model is tiny, per-dispatch latency
+        dominates and host BLAS + argpartition is faster. Probe a real
+        query at deploy time and keep whichever layout serves faster
+        (threshold PIO_SERVE_DEVICE_MS, default 3 ms). No reference
+        analogue — MLlib serving is always JVM-host-side."""
+        import os
+        import time
+
+        import jax
+
+        if isinstance(model.user_factors, np.ndarray):
+            return model  # already host-side
+        try:
+            k = min(10, len(model.item_vocab))
+            ix = np.int32(0)
+            # warm the compile, then time the steady state
+            jax.block_until_ready(topk.topk_for_user(
+                model.user_factors, model.item_factors, ix, k=k))
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.device_get(topk.topk_for_user(
+                    model.user_factors, model.item_factors, ix, k=k))
+            per_query_ms = (time.perf_counter() - t0) / 3 * 1e3
+        except Exception:
+            return model
+        threshold = float(os.environ.get("PIO_SERVE_DEVICE_MS", "3.0"))
+        if per_query_ms > threshold:
+            import logging
+            logging.getLogger("predictionio_tpu.recommendation").info(
+                "device round-trip %.2fms > %.1fms; serving from host "
+                "arrays", per_query_ms, threshold)
+            return ALSModel(
+                rank=model.rank,
+                user_factors=np.asarray(model.user_factors),
+                item_factors=np.asarray(model.item_factors),
+                user_vocab=model.user_vocab, item_vocab=model.item_vocab)
+        return model
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         user_ix = model.user_vocab.get(query.user)
@@ -106,10 +162,17 @@ class ALSAlgorithm(Algorithm):
             # unknown user -> empty result (ALSAlgorithm.scala:104-108)
             return PredictedResult(())
         k = min(query.num, len(model.item_vocab))
-        vals, idx = topk.topk_scores(
-            model.user_factors[user_ix], model.item_factors, k=k)
+        if isinstance(model.user_factors, np.ndarray):
+            # host serving: one BLAS matvec + argpartition
+            scores = model.item_factors @ model.user_factors[user_ix]
+            vals, idx = topk.host_topk(scores, k)
+        else:
+            import jax
+
+            vals, idx = jax.device_get(topk.topk_for_user(
+                model.user_factors, model.item_factors,
+                np.int32(user_ix), k=k))
         inv = model.item_vocab.inverse()
-        vals, idx = np.asarray(vals), np.asarray(idx)
         return PredictedResult(tuple(
             ItemScore(item=inv(int(i)), score=float(s))
             for s, i in zip(vals, idx)))

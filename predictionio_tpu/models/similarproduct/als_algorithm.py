@@ -160,7 +160,7 @@ class ALSAlgorithm(Algorithm):
             np.random.SeedSequence().entropy % (2 ** 31))
         prepared = als.prepare_ratings(
             u_idx, i_idx, vals,
-            n_users=len(user_vocab), n_items=len(item_vocab))
+            n_users=len(user_vocab), n_items=len(item_vocab), device=True)
         _U, V = als.train_implicit(
             prepared, rank=self.ap.rank, iterations=self.ap.numIterations,
             lambda_=self.ap.lambda_, alpha=1.0, seed=int(seed))

@@ -1,0 +1,95 @@
+"""Native (C++) runtime kernels, loaded via ctypes.
+
+The reference delegates its dense math to Spark MLlib and its ETL to the
+RDD runtime (SURVEY.md §2 language note). Here the TPU owns the math
+(JAX/XLA) and this package owns the host-side hot loops that feed it —
+starting with the counting-sort data-layout kernel behind
+ops.als.prepare_ratings.
+
+The shared library is compiled on first use with g++ (baked into the image;
+pybind11 is not, hence ctypes) and cached next to the source. Every entry
+point degrades to a numpy fallback if the toolchain is unavailable, so the
+framework never hard-depends on the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "counting_sort.cpp")
+_LIB = os.path.join(_HERE, "_pio_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # missing g++, RO filesystem, ...
+        _log.warning("native build failed (%s); using numpy fallbacks", e)
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PIO_DISABLE_NATIVE"):
+            return None
+        fresh = (os.path.exists(_LIB) and
+                 os.path.getmtime(_LIB) >= os.path.getmtime(_SRC))
+        if not fresh and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            _log.warning("native load failed (%s); using numpy fallbacks", e)
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.pio_counting_sort_coo.argtypes = [
+            i32p, i32p, f32p, ctypes.c_int64, ctypes.c_int32,
+            i32p, i32p, f32p, i32p]
+        lib.pio_counting_sort_coo.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def counting_sort_coo(keys: np.ndarray, other: np.ndarray, vals: np.ndarray,
+                      n_keys: int):
+    """Stable sort of (keys, other, vals) by keys plus per-key counts,
+    in O(n). Returns (keys_sorted, other_sorted, vals_sorted, counts) or
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int32)
+    other = np.ascontiguousarray(other, dtype=np.int32)
+    vals = np.ascontiguousarray(vals, dtype=np.float32)
+    n = keys.shape[0]
+    ks = np.empty(n, dtype=np.int32)
+    os_ = np.empty(n, dtype=np.int32)
+    vs = np.empty(n, dtype=np.float32)
+    counts = np.zeros(n_keys, dtype=np.int32)
+    lib.pio_counting_sort_coo(keys, other, vals, n, n_keys, ks, os_, vs,
+                              counts)
+    return ks, os_, vs, counts

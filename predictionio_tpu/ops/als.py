@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from predictionio_tpu import native
 from predictionio_tpu.parallel.mesh import pad_to_multiple
 
 _EPS = 1e-8
@@ -74,6 +75,33 @@ class ALSData:
     nnz: int
 
 
+def group_coo(keys: np.ndarray, other: np.ndarray, vals: np.ndarray,
+              n_keys: int):
+    """Stable-sort the COO triple by key + per-key counts.
+
+    Hot ETL: the native O(n) counting sort (predictionio_tpu.native) when
+    the toolchain is available, numpy argsort otherwise.
+    """
+    res = native.counting_sort_coo(keys, other, vals, n_keys)
+    if res is not None:
+        return res
+    order = np.argsort(keys, kind="stable")
+    s = keys[order]
+    return (s, other[order], vals[order],
+            np.bincount(s, minlength=n_keys).astype(np.int32))
+
+
+@partial(jax.jit, static_argnames=("n_a", "nnz_pad"))
+def _side_device(a, b, r, n_a: int, nnz_pad: int):
+    """On-device layout: variadic XLA sort keyed on the self index + padded
+    COO + per-row counts, entirely in HBM (no host round-trip)."""
+    s, o, rr = lax.sort((a, b, r), num_keys=1)
+    counts = jnp.bincount(a, length=n_a).astype(jnp.int32)
+    extra = nnz_pad - s.shape[0]
+    return (jnp.pad(s, (0, extra), constant_values=n_a),
+            jnp.pad(o, (0, extra)), jnp.pad(rr, (0, extra)), counts)
+
+
 def prepare_ratings(
     user_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -81,24 +109,44 @@ def prepare_ratings(
     n_users: int,
     n_items: int,
     chunk: int = 1 << 18,
+    device: bool = False,
 ) -> ALSData:
-    """Sort + pad the COO ratings both ways (host side, single pass each).
+    """Sort + pad the COO ratings both ways.
 
     This subsumes the reference's BiMap-encode + RDD repartition ETL
     (ALSAlgorithm.scala:50-94): encoding happened upstream in
     store.find_columnar; here we lay the data out for the device.
+
+    device=False lays out on host with an O(n)-pass pack-sort (for the
+    mesh-sharded path, which re-partitions on host); device=True ships the
+    raw COO to the device once and does both sorted layouts there with XLA
+    variadic sorts — the single-device trainers consume the resulting
+    jax arrays with zero further host work, so `pio train` ETL is one
+    240MB-at-20M transfer plus two in-HBM sorts.
     """
     user_idx = np.asarray(user_idx, dtype=np.int32)
     item_idx = np.asarray(item_idx, dtype=np.int32)
     rating = np.asarray(rating, dtype=np.float32)
     nnz = user_idx.shape[0]
 
+    if device:
+        nnz_pad = max(((nnz + chunk - 1) // chunk) * chunk, chunk)
+        u, i, r = (jnp.asarray(user_idx), jnp.asarray(item_idx),
+                   jnp.asarray(rating))
+
+        def side_dev(a, b, n_a, n_b) -> COOSide:
+            s, o, rr, counts = _side_device(a, b, r, n_a, nnz_pad)
+            return COOSide(self_idx=s, other_idx=o, rating=rr,
+                           counts=counts, n_self=n_a, n_other=n_b)
+
+        return ALSData(
+            by_user=side_dev(u, i, n_users, n_items),
+            by_item=side_dev(i, u, n_items, n_users),
+            n_users=n_users, n_items=n_items, nnz=nnz,
+        )
+
     def side(a_idx, b_idx, n_a, n_b) -> COOSide:
-        # only segment GROUPING matters, not order within a segment, so
-        # the (faster) unstable sort is fine
-        order = np.argsort(a_idx)
-        s, o, r = a_idx[order], b_idx[order], rating[order]
-        counts = np.bincount(s, minlength=n_a).astype(np.int32)
+        s, o, r, counts = group_coo(a_idx, b_idx, rating, n_a)
         return COOSide(
             self_idx=pad_to_multiple(s, chunk, n_a),
             other_idx=pad_to_multiple(o, chunk, 0),

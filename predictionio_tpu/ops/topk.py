@@ -35,6 +35,31 @@ def topk_scores(
 
 
 @partial(jax.jit, static_argnames=("k",))
+def topk_for_user(
+    user_factors: jnp.ndarray,   # (n_users, r) device-resident
+    item_factors: jnp.ndarray,   # (n_items, r) device-resident
+    user_ix: jnp.ndarray,        # () int32
+    k: int = 10,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused single-query serve: row gather + matvec + top_k in ONE
+    dispatch, so a remote/tunneled device costs one round-trip per query
+    instead of four (gather, matmul, and two fetches)."""
+    q = jnp.take(user_factors, user_ix, axis=0)
+    return jax.lax.top_k(item_factors @ q, k)
+
+
+def host_topk(scores, k: int):
+    """numpy argpartition top-K for host-side serving (small models or
+    remote devices where per-query dispatch latency dominates)."""
+    import numpy as np
+
+    k = min(k, scores.shape[-1])
+    idx = np.argpartition(-scores, k - 1)[:k]
+    idx = idx[np.argsort(-scores[idx], kind="stable")]
+    return scores[idx], idx
+
+
+@partial(jax.jit, static_argnames=("k",))
 def topk_scores_batch(
     query_vecs: jnp.ndarray,     # (b, r)
     item_factors: jnp.ndarray,   # (n_items, r)

@@ -73,15 +73,23 @@ def _load_engine_and_params(args):
     return engine_dir, variant, engine, engine_params
 
 
-def _make_context(batch: str = ""):
+def _make_context(batch: str = "", devices: int = 0,
+                  profile_dir: Optional[str] = None):
     from predictionio_tpu.workflow import WorkflowContext, WorkflowParams
-    return WorkflowContext(workflow_params=WorkflowParams(batch=batch))
+    mesh = None
+    if devices and devices > 1:
+        from predictionio_tpu.parallel.mesh import get_mesh
+        mesh = get_mesh(devices)
+    return WorkflowContext(
+        workflow_params=WorkflowParams(batch=batch, profile_dir=profile_dir),
+        mesh=mesh)
 
 
 def cmd_train(args) -> int:
     from predictionio_tpu.workflow import run_train
     _engine_dir, variant, engine, engine_params = _load_engine_and_params(args)
-    ctx = _make_context(batch=args.batch)
+    ctx = _make_context(batch=args.batch, devices=args.devices,
+                        profile_dir=args.profile or None)
     instance_id = run_train(
         ctx, engine, engine_params,
         engine_id=variant.get("id", "default"),
@@ -196,6 +204,21 @@ def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin import AdminAPI
     _info(f"Admin server is started at {args.ip}:{args.port}.")
     serve_forever(AdminAPI(), host=args.ip, port=args.port)
+    return 0
+
+
+def cmd_storageserver(args) -> int:
+    """Expose this node's storage over HTTP so other machines can point a
+    `remote`-type source at it (the networked-store role the reference
+    fills with PostgreSQL/HBase; data/storage/remote.py)."""
+    from predictionio_tpu.data.api.http import serve_forever
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.data.storage.remote import StorageRPCAPI
+    key = args.key or os.environ.get("PIO_STORAGE_SERVER_KEY") or None
+    _info(f"Storage server is started at {args.ip}:{args.port}"
+          f"{' (key auth on)' if key else ''}.")
+    serve_forever(StorageRPCAPI(get_storage(), key=key),
+                  host=args.ip, port=args.port)
     return 0
 
 
@@ -354,6 +377,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--resume-from", default=None,
                     help="instance id of a crashed run whose iteration "
                          "snapshots should seed this training")
+    sp.add_argument("--devices", type=int, default=0,
+                    help="train block-sharded over the first N devices "
+                         "(default: single-device)")
+    sp.add_argument("--profile", default="",
+                    help="write a jax.profiler trace to this directory")
 
     sp = sub.add_parser("eval", help="run an evaluation")
     sp.add_argument("evaluation_class")
@@ -394,6 +422,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("adminserver", help="start the admin API server")
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=7071)
+
+    sp = sub.add_parser("storageserver",
+                        help="serve this node's storage to remote clients")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=7072)
+    sp.add_argument("--key", default="",
+                    help="shared secret clients must send "
+                         "(X-PIO-Storage-Key)")
 
     sp = sub.add_parser("app", help="manage apps")
     asub = sp.add_subparsers(dest="app_command", required=True)
@@ -462,6 +498,7 @@ _DISPATCH = {
     "eventserver": cmd_eventserver,
     "dashboard": cmd_dashboard,
     "adminserver": cmd_adminserver,
+    "storageserver": cmd_storageserver,
     "status": cmd_status,
     "app": cmd_app,
     "accesskey": cmd_accesskey,

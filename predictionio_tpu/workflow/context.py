@@ -11,7 +11,9 @@ One context per run. It owns:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import time
 from typing import Dict, Optional
 
 from predictionio_tpu.data.storage import Storage, get_storage
@@ -20,12 +22,15 @@ from predictionio_tpu.data.storage import Storage, get_storage
 @dataclasses.dataclass
 class WorkflowParams:
     """Mirror of WorkflowParams.scala (batch, verbose, skipSanityCheck,
-    stopAfterRead, stopAfterPrepare)."""
+    stopAfterRead, stopAfterPrepare) + profile_dir: when set, run_train
+    wraps training in jax.profiler.trace (SURVEY.md §5 — the Spark-UI
+    replacement)."""
     batch: str = ""
     verbose: int = 2
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    profile_dir: Optional[str] = None
 
 
 class WorkflowContext:
@@ -43,6 +48,19 @@ class WorkflowContext:
         self.runtime_env = dict(runtime_env or {})
         # appName analogue: "PredictionIO <mode>: <batch>" (WorkflowContext.scala:36-38)
         self.app_name = app_name
+        # per-phase wall-clock (SURVEY.md §5 tracing: the Spark-UI
+        # replacement); run_train persists it in the EngineInstance row
+        self.phase_seconds: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phase_seconds[name] = (
+                self.phase_seconds.get(name, 0.0)
+                + time.perf_counter() - t0)
 
     @property
     def storage(self) -> Storage:

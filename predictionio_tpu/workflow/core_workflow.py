@@ -72,16 +72,36 @@ def run_train(
     from predictionio_tpu.workflow.checkpoint import run_checkpoint_dir
     ctx.checkpoint_dir = run_checkpoint_dir(resume_from or instance_id)
     try:
-        models = engine.train(ctx, engine_params)
-        models = engine.make_serializable_models(
-            ctx, instance_id, engine_params, models)
-        blob = model_io.serialize_models(models)
-        storage.get_model_data_models().insert(Model(id=instance_id, models=blob))
-        row = instances.get(instance_id)
-        instances.update(EngineInstance(
-            **{**row.__dict__, "status": "COMPLETED", "end_time": _now()}))
+        profile_dir = getattr(ctx.workflow_params, "profile_dir", None)
+        if profile_dir:
+            # JAX profiler trace — the Spark-UI replacement (SURVEY.md §5);
+            # view with tensorboard or xprof
+            import jax
+
+            with jax.profiler.trace(profile_dir):
+                models = engine.train(ctx, engine_params)
+        else:
+            models = engine.train(ctx, engine_params)
+        with ctx.phase("persist"):
+            models = engine.make_serializable_models(
+                ctx, instance_id, engine_params, models)
+            blob = model_io.serialize_models(models)
+            storage.get_model_data_models().insert(
+                Model(id=instance_id, models=blob))
+        phases = dict(ctx.phase_seconds)
         logger.info("Training completed; EngineInstance %s COMPLETED "
                     "(model blob %d bytes)", instance_id, len(blob))
+        row = instances.get(instance_id)
+        instances.update(EngineInstance(
+            **{**row.__dict__, "status": "COMPLETED", "end_time": _now(),
+               "runtime_conf": {**row.runtime_conf,
+                                **{f"phase_{k}_s": f"{v:.3f}"
+                                   for k, v in phases.items()}}}))
+        if phases:
+            width = max(len(k) for k in phases)
+            table = "\n".join(f"  {k.ljust(width)}  {v:8.3f}s"
+                              for k, v in phases.items())
+            logger.info("Phase wall-clock:\n%s", table)
         # the model blob persists the final state; snapshots are scratch
         from predictionio_tpu.workflow.checkpoint import FactorCheckpointer
         FactorCheckpointer(ctx.checkpoint_dir).clear()

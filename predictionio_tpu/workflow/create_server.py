@@ -168,6 +168,8 @@ class QueryAPI:
         models = prepare_deploy(
             self.ctx, engine, engine_params, instance.id, models,
             algorithms=algorithms)
+        models = [a.prepare_serving(m)
+                  for a, m in zip(algorithms, models)]
         with self._lock:
             self.engine_instance = instance
             self.engine = engine

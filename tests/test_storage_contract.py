@@ -35,8 +35,32 @@ def mk(event="rate", entity_id="u1", target=None, minute=0, props=None):
     )
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "remote"])
 def storage(request, tmp_path):
+    if request.param == "remote":
+        # the networked backend: a storage server wrapping sqlite, with the
+        # `remote` client driver pointed at it over real HTTP + key auth
+        from predictionio_tpu.data.storage.remote import serve_storage
+        backing = Storage(env={
+            "PIO_STORAGE_SOURCES_B_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path / "backing.sqlite"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "B",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "B",
+        })
+        server = serve_storage(backing, host="127.0.0.1", port=0,
+                               key="sekrit")
+        port = server.server_address[1]
+        yield Storage(env={
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+            "PIO_STORAGE_SOURCES_R_KEY": "sekrit",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+        })
+        server.shutdown()
+        return
     if request.param == "memory":
         env = {
             "PIO_STORAGE_SOURCES_T_TYPE": "memory",
@@ -44,7 +68,7 @@ def storage(request, tmp_path):
             "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
             "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T",
         }
-    else:
+    elif request.param == "sqlite":
         env = {
             "PIO_STORAGE_SOURCES_T_TYPE": "sqlite",
             "PIO_STORAGE_SOURCES_T_PATH": str(tmp_path / "t.sqlite"),
@@ -52,7 +76,18 @@ def storage(request, tmp_path):
             "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
             "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T",
         }
-    return Storage(env=env)
+    else:
+        # columnar event log provides EVENTDATA only (the HBase role);
+        # metadata/models ride the memory backend
+        env = {
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "eventlog"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        }
+    yield Storage(env=env)
 
 
 class TestEventsContract:
