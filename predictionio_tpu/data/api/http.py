@@ -63,14 +63,23 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(api, host: str = "localhost",
-                port: int = 0) -> ThreadingHTTPServer:
+                port: int = 0, tls: bool = True) -> ThreadingHTTPServer:
     """Build (without starting) a threaded HTTP server around `api`.
 
     port=0 binds an ephemeral port; read it from server.server_address.
+    TLS engages automatically when PIO_SSL_CERTFILE is configured
+    (SSLConfiguration.scala role); pass tls=False to force plaintext.
     """
     handler = type("BoundHandler", (_Handler,), {"api": api})
     server = ThreadingHTTPServer((host, port), handler)
     server.daemon_threads = True
+    if tls:
+        from predictionio_tpu.common.server_security import maybe_wrap_ssl
+        scheme = maybe_wrap_ssl(server)
+        if scheme == "https":
+            import logging
+            logging.getLogger("predictionio_tpu.http").info(
+                "TLS enabled (PIO_SSL_CERTFILE)")
     return server
 
 

@@ -195,7 +195,8 @@ def cmd_dashboard(args) -> int:
     from predictionio_tpu.data.api.http import serve_forever
     from predictionio_tpu.tools.dashboard import DashboardAPI
     _info(f"Dashboard is started at {args.ip}:{args.port}.")
-    serve_forever(DashboardAPI(), host=args.ip, port=args.port)
+    serve_forever(DashboardAPI(server_key=args.key or None),
+                  host=args.ip, port=args.port)
     return 0
 
 
@@ -203,7 +204,8 @@ def cmd_adminserver(args) -> int:
     from predictionio_tpu.data.api.http import serve_forever
     from predictionio_tpu.tools.admin import AdminAPI
     _info(f"Admin server is started at {args.ip}:{args.port}.")
-    serve_forever(AdminAPI(), host=args.ip, port=args.port)
+    serve_forever(AdminAPI(server_key=args.key or None),
+                  host=args.ip, port=args.port)
     return 0
 
 
@@ -418,10 +420,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("dashboard", help="start the evaluation dashboard")
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9000)
+    sp.add_argument("--key", default="",
+                    help="require this server key (or set PIO_SERVER_KEY)")
 
     sp = sub.add_parser("adminserver", help="start the admin API server")
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=7071)
+    sp.add_argument("--key", default="",
+                    help="require this server key (or set PIO_SERVER_KEY)")
 
     sp = sub.add_parser("storageserver",
                         help="serve this node's storage to remote clients")

@@ -18,13 +18,20 @@ Response = Tuple[int, Any]
 
 
 class DashboardAPI:
-    def __init__(self, storage: Optional[Storage] = None):
+    def __init__(self, storage: Optional[Storage] = None,
+                 server_key: Optional[str] = None):
+        from predictionio_tpu.common.server_security import KeyAuth
         self.storage = storage if storage is not None else get_storage()
+        self.auth = KeyAuth(server_key)
 
     def handle(self, method: str, path: str,
                query: Optional[Dict[str, str]] = None,
                body: bytes = b"",
                headers: Optional[Dict[str, str]] = None) -> Response:
+        # KeyAuthentication.scala parity: reject before routing
+        rejected = self.auth.gate(headers, query)
+        if rejected is not None:
+            return rejected
         method = method.upper()
         path = (path or "/").rstrip("/") or "/"
         if method != "GET":
