@@ -11,9 +11,19 @@ cert/key pair from PIO_SSL_CERTFILE / PIO_SSL_KEYFILE.
 
 from __future__ import annotations
 
+import hmac
 import os
 import ssl
 from typing import Dict, Optional
+
+
+def _digest_eq(given: str, expected: str) -> bool:
+    """Constant-time string equality. compare_digest rejects non-ASCII str,
+    so compare encoded bytes (surrogateescape keeps undecodable header
+    bytes comparable instead of raising)."""
+    return hmac.compare_digest(
+        given.encode("utf-8", "surrogateescape"),
+        expected.encode("utf-8", "surrogateescape"))
 
 
 class KeyAuth:
@@ -35,9 +45,11 @@ class KeyAuth:
         if not self.key:
             return True
         h = {k.lower(): v for k, v in (headers or {}).items()}
-        if h.get(self.HEADER) == self.key:
+        # constant-time comparison: a plain == leaks key prefixes through
+        # response timing
+        if _digest_eq(h.get(self.HEADER, ""), self.key):
             return True
-        return (query or {}).get(self.PARAM) == self.key
+        return _digest_eq((query or {}).get(self.PARAM, ""), self.key)
 
     def gate(self, headers, query):
         """None when authorized, else the (status, payload) rejection."""

@@ -7,10 +7,15 @@ framework's hot read is different: bulk-load EVERYTHING for an (app,
 channel) into columnar host buffers and `device_put` straight to HBM. This
 backend is an LSM-style log designed for that path:
 
-- inserts append to a **write-ahead log** (``wal.jsonl``, one JSON line per
-  event, written before the insert is acknowledged) and to an in-memory
-  buffer; at ``_FLUSH_AT`` events the buffer compacts into an immutable
-  **columnar chunk** (``chunk_<seq>.npz``): int32 dictionary codes for
+- inserts append to a **write-ahead log** (``wal_<seq>.jsonl``, one JSON
+  line per event, written before the insert is acknowledged) and to an
+  in-memory buffer; at ``_FLUSH_AT`` events the buffer compacts into an
+  immutable **columnar chunk** (``chunk_<seq>.npz``). The WAL is named
+  after the chunk seq its rows will become, which makes flush and replay
+  idempotent: the existence of ``chunk_<s>.npz`` supersedes
+  ``wal_<s>.jsonl`` everywhere, so a crash between chunk publication and
+  WAL removal neither duplicates rows on restart nor shows a concurrent
+  reader the same rows twice. Chunk columns: int32 dictionary codes for
   every string field, int64 epoch-millis times, one float64 column (+ a
   was-int flag column) per numeric scalar property, and a packed JSON
   side-channel for everything else (non-numeric properties, tags, prId);
@@ -38,6 +43,7 @@ from __future__ import annotations
 import atexit
 import datetime as _dt
 import json
+import logging
 import os
 import shutil
 import threading
@@ -50,6 +56,8 @@ from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import (
     Events, event_matches,
 )
+
+logger = logging.getLogger(__name__)
 
 _FLUSH_AT = 1 << 16  # buffered events per (app, channel) before compaction
 _MAX_EXACT_INT = 1 << 53  # beyond float64 exactness -> JSON side-channel
@@ -94,7 +102,6 @@ class _Shard:
         self.chunk_dir = os.path.join(root, "chunks")
         os.makedirs(self.chunk_dir, exist_ok=True)
         self.dict_path = os.path.join(root, "dict.jsonl")
-        self.wal_path = os.path.join(root, "wal.jsonl")
         self.tomb_path = os.path.join(root, "tombstones.json")
         self.pool: List[str] = []
         self.codes: Dict[str, int] = {}
@@ -119,10 +126,19 @@ class _Shard:
                 f.write(self.token)
         seqs = self.chunk_seqs()
         self.next_seq = max(seqs) + 1 if seqs else 0
+        # pre-round-3 layout used a single truncated wal.jsonl; adopt it as
+        # the WAL for the current seq so no acknowledged event is dropped
+        legacy = os.path.join(root, "wal.jsonl")
+        if os.path.exists(legacy) and not os.path.exists(
+                self.wal_path_for(self.next_seq)):
+            os.replace(legacy, self.wal_path_for(self.next_seq))
         self.buffer: List[Event] = []
         self.wal_offset = 0
         self.dirty = False  # True only after a LOCAL write (writer role)
         self.refresh_wal()
+
+    def wal_path_for(self, seq: int) -> str:
+        return os.path.join(self.root, f"wal_{seq}.jsonl")
 
     # -- append-only file tailing (cross-process read-your-writes) ---------
     def refresh_dict(self) -> None:
@@ -140,39 +156,84 @@ class _Shard:
             self.dict_offset = f.tell()
 
     def refresh_wal(self) -> None:
-        """Tail the writer's WAL into our buffer view. The writer keeps
-        wal_offset == file size by construction, so this is a no-op for it;
-        a shrink means the writer compacted a chunk — rebuild from zero."""
-        size = (os.path.getsize(self.wal_path)
-                if os.path.exists(self.wal_path) else 0)
-        if size == self.wal_offset:
-            return
-        if size < self.wal_offset:
-            self.buffer = []
-            self.wal_offset = 0
-            # the compacted chunk is new to us too
+        """Sync the buffer view with the writer's per-seq WAL.
+
+        The buffer mirrors ``wal_<next_seq>.jsonl``. If a chunk exists for
+        a seq, the chunk supersedes that seq's WAL (flushed rows live in
+        exactly one place), so after tailing we re-check for a concurrent
+        compaction and advance until stable — a reader can never observe
+        the same rows both as chunk rows and as its buffer."""
+        while True:
             seqs = self.chunk_seqs()
-            self.next_seq = max(seqs) + 1 if seqs else 0
-        with open(self.wal_path, encoding="utf-8") as f:
-            f.seek(self.wal_offset)
-            for line in f:
-                try:
-                    self.buffer.append(Event.from_dict(
-                        json.loads(line), validate=False))
-                except ValueError:
-                    continue  # torn tail write mid-crash
-            self.wal_offset = f.tell()
+            next_seq = max(seqs) + 1 if seqs else 0
+            if next_seq != self.next_seq:
+                # our buffered rows were compacted into chunks (or the
+                # shard was reset externally): rebuild from the new WAL
+                self.buffer = []
+                self.wal_offset = 0
+                self.next_seq = next_seq
+            path = self.wal_path_for(self.next_seq)
+            size = os.path.getsize(path) if os.path.exists(path) else 0
+            if size < self.wal_offset:
+                self.buffer = []
+                self.wal_offset = 0
+            if size > self.wal_offset:
+                self._tail_wal(path)
+            if not os.path.exists(self.chunk_path(self.next_seq)):
+                return
+
+    def _tail_wal(self, path: str) -> None:
+        """Byte-exact tail: consume only newline-terminated records, so a
+        record observed mid-write is retried on the next refresh instead of
+        being mis-parsed. A complete line that fails to parse is real
+        corruption of an acknowledged event — warn, never silently drop."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(self.wal_offset)
+                data = f.read()
+        except FileNotFoundError:
+            # concurrent writer compacted + GC'd this WAL between our
+            # getsize and open; the chunk-exists re-check in refresh_wal
+            # picks the rows up from the chunk
+            return
+        end = data.rfind(b"\n")
+        if end < 0:
+            return
+        consumed = data[: end + 1]
+        offset = self.wal_offset
+        for line in consumed.split(b"\n")[:-1]:
+            try:
+                self.buffer.append(Event.from_dict(
+                    json.loads(line.decode("utf-8")), validate=False))
+            except (ValueError, UnicodeDecodeError) as e:
+                logger.warning(
+                    "eventlog: skipping corrupt WAL record at %s offset %d "
+                    "(%s) — an acknowledged event may be lost",
+                    path, offset, e)
+            offset += len(line) + 1
+        self.wal_offset += len(consumed)
 
     def append_wal(self, events: Sequence[Event]) -> None:
-        with open(self.wal_path, "a", encoding="utf-8") as f:
+        with open(self.wal_path_for(self.next_seq), "a",
+                  encoding="utf-8") as f:
             for e in events:
                 f.write(json.dumps(e.to_dict(with_event_id=False)) + "\n")
             f.flush()
             self.wal_offset = f.tell()
 
-    def truncate_wal(self) -> None:
-        open(self.wal_path, "w").close()
-        self.wal_offset = 0
+    def drop_stale_wals(self) -> None:
+        """Writer-side GC of WALs already superseded by chunks."""
+        for fn in os.listdir(self.root):
+            if fn.startswith("wal_") and fn.endswith(".jsonl"):
+                try:
+                    seq = int(fn[len("wal_"):-len(".jsonl")])
+                except ValueError:
+                    continue
+                if seq < self.next_seq:
+                    try:
+                        os.remove(os.path.join(self.root, fn))
+                    except FileNotFoundError:
+                        pass
 
     def add_strings(self, strings: Sequence[str]) -> None:
         new = []
@@ -358,11 +419,16 @@ class EventlogEvents(Events):
         path = sh.chunk_path(sh.next_seq)
         with open(path + ".tmp", "wb") as f:
             np.savez(f, **out)
+        # publication order is the crash-safety contract: once the chunk is
+        # visible its rows are durable and its WAL is superseded (readers
+        # and replay both resolve chunk-over-WAL), so removing the WAL
+        # after — even after a crash in between — never duplicates rows
         os.replace(path + ".tmp", path)
         sh.buffer = []
-        sh.truncate_wal()
+        sh.wal_offset = 0
         sh.next_seq += 1
         sh.dirty = False
+        sh.drop_stale_wals()
 
     def append_encoded(
         self,
@@ -416,6 +482,7 @@ class EventlogEvents(Events):
             os.replace(path + ".tmp", path)
             sh.next_seq += 1
             sh.dirty = False
+            sh.drop_stale_wals()
 
     # -- point reads ---------------------------------------------------------
     def _materialize(self, sh: _Shard, seq: int, data, row: int,

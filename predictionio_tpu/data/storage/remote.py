@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import base64
 import datetime as _dt
+import hmac
 import json
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence
@@ -243,7 +244,10 @@ class StorageRPCAPI:
                body: bytes = b"",
                headers: Optional[Dict[str, str]] = None):
         headers = {k.lower(): v for k, v in (headers or {}).items()}
-        if self.key and headers.get("x-pio-storage-key") != self.key:
+        if self.key and not hmac.compare_digest(
+                headers.get("x-pio-storage-key", "").encode(
+                    "utf-8", "surrogateescape"),
+                self.key.encode("utf-8", "surrogateescape")):
             return 401, {"message": "invalid storage key"}
         if method == "GET" and path == "/":
             return 200, {"status": "alive"}
@@ -268,24 +272,47 @@ class StorageRPCAPI:
 # --------------------------------------------------------------------------
 
 class StorageClient:
-    """props: URL (http://host:port) [+ KEY, TIMEOUT]."""
+    """props: URL (http://host:port or https://host:port)
+    [+ KEY, TIMEOUT, CAFILE, VERIFY=false].
+
+    An https:// URL connects over TLS (the server side auto-enables TLS
+    when PIO_SSL_CERTFILE is set — serve_storage inherits it via
+    common.server_security.maybe_wrap_ssl). CAFILE pins a custom CA (e.g.
+    the self-signed cert from conf/); VERIFY=false disables verification
+    for lab setups."""
 
     def __init__(self, config):
         url = config.properties.get("URL", "http://localhost:7072")
+        scheme = "http"
         if "://" in url:
-            url = url.split("://", 1)[1]
+            scheme, url = url.split("://", 1)
+        self.tls = scheme.lower() == "https"
         self.host, _, port = url.partition(":")
         self.port = int(port.rstrip("/") or 7072)
         self.key = config.properties.get("KEY")
         self.timeout = float(config.properties.get("TIMEOUT", "30"))
+        self.cafile = config.properties.get("CAFILE")
+        self.verify = (config.properties.get(
+            "VERIFY", "true").lower() != "false")
         self._local = threading.local()
 
     def _conn(self):
         import http.client
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = http.client.HTTPConnection(
-                self.host, self.port, timeout=self.timeout)
+            if self.tls:
+                import ssl
+                if self.verify:
+                    ctx = ssl.create_default_context(cafile=self.cafile)
+                else:
+                    ctx = ssl.create_default_context()
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE
+                conn = http.client.HTTPSConnection(
+                    self.host, self.port, timeout=self.timeout, context=ctx)
+            else:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout)
             self._local.conn = conn
         return conn
 

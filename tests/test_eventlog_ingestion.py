@@ -228,3 +228,93 @@ def test_eventlog_persists_across_instances(tmp_path):
     }
     s2 = Storage(env=env)
     assert len(list(s2.get_events().find(app_id))) == 53
+
+
+def test_flush_crash_window_idempotent(tmp_path):
+    """ADVICE r2 (medium): a crash between chunk publication and WAL
+    removal must not duplicate rows — for a restarted writer, a fresh
+    reader, or a reader that was already tailing the WAL."""
+    s1, app_id = make_storage(tmp_path, "eventlog")
+    ev1 = s1.get_events()
+    rng = np.random.default_rng(3)
+    evs = seed_events(rng, n=10)
+    ev1.insert_batch(evs, app_id)
+
+    # a reader process opens mid-window and tails the WAL
+    s_r = Storage(env=el_env(tmp_path))
+    reader = s_r.get_events()
+    assert len(list(reader.find(app_id))) == 13
+
+    # snapshot the WAL, flush (chunk published + WAL removed), then put the
+    # WAL back: exactly the on-disk state after a crash between the two
+    sh = ev1._shard(app_id, None)
+    wal = sh.wal_path_for(sh.next_seq)
+    blob = open(wal, "rb").read()
+    ev1.flush(app_id)
+    with open(wal, "wb") as f:
+        f.write(blob)
+
+    # fresh reader: chunk supersedes its WAL — rows appear exactly once
+    s2 = Storage(env=el_env(tmp_path))
+    assert len(list(s2.get_events().find(app_id))) == 13
+    # the pre-existing reader refreshes through the same window
+    assert len(list(reader.find(app_id))) == 13
+    col = s2.get_events().read_columns(app_id, event_names=["rate", "buy"])
+    assert len(col["rating"]) == 10
+
+    # restarted writer: replays nothing for the superseded WAL, and its
+    # next flush does not re-compact those rows into a second chunk
+    s3 = Storage(env=el_env(tmp_path))
+    ev3 = s3.get_events()
+    ev3.insert(Event(event="rate", entity_type="user", entity_id="u99",
+                     target_entity_type="item", target_entity_id="i0",
+                     properties=DataMap({"rating": 1.0})), app_id)
+    ev3.flush(app_id)
+    s4 = Storage(env=el_env(tmp_path))
+    assert len(list(s4.get_events().find(app_id))) == 14
+
+
+def test_wal_midfile_corruption_warns(tmp_path, caplog):
+    """ADVICE r2 (low): corruption of a complete WAL line is not a torn
+    tail — it must be logged, and surrounding events must survive."""
+    import logging
+
+    s1, app_id = make_storage(tmp_path, "eventlog")
+    ev1 = s1.get_events()
+    ev1.insert_batch(seed_events(np.random.default_rng(4), n=5)[:5], app_id)
+    sh = ev1._shard(app_id, None)
+    wal = sh.wal_path_for(sh.next_seq)
+    lines = open(wal, "rb").read().split(b"\n")
+    lines[2] = b'{"busted'
+    with open(wal, "wb") as f:
+        f.write(b"\n".join(lines))
+    with caplog.at_level(logging.WARNING):
+        s2 = Storage(env=el_env(tmp_path))
+        got = list(s2.get_events().find(app_id))
+    assert len(got) == 4
+    assert any("corrupt WAL record" in r.message for r in caplog.records)
+
+
+def test_wal_incomplete_tail_retried_not_misparsed(tmp_path):
+    """A record observed mid-write (no trailing newline) is not consumed;
+    once the writer completes it, the same reader picks it up whole."""
+    s1, app_id = make_storage(tmp_path, "eventlog")
+    ev1 = s1.get_events()
+    ev1.insert(Event(event="rate", entity_type="user", entity_id="u1",
+                     target_entity_type="item", target_entity_id="i1",
+                     properties=DataMap({"rating": 2.0})), app_id)
+    sh = ev1._shard(app_id, None)
+    wal = sh.wal_path_for(sh.next_seq)
+    full = Event(event="rate", entity_type="user", entity_id="u2",
+                 target_entity_type="item", target_entity_id="i2",
+                 properties=DataMap({"rating": 3.0}))
+    import json as _json
+    line = _json.dumps(full.to_dict(with_event_id=False)) + "\n"
+    with open(wal, "a", encoding="utf-8") as f:
+        f.write(line[:10])  # partial write observed by the reader
+    s_r = Storage(env=el_env(tmp_path))
+    reader = s_r.get_events()
+    assert {e.entity_id for e in reader.find(app_id)} == {"u1"}
+    with open(wal, "a", encoding="utf-8") as f:
+        f.write(line[10:])
+    assert {e.entity_id for e in reader.find(app_id)} == {"u1", "u2"}

@@ -77,3 +77,47 @@ def test_tls_end_to_end(memory_storage, tmp_path, monkeypatch):
             assert r.status == 200  # unreachable
     finally:
         server.shutdown()
+
+
+def test_remote_backend_over_tls(memory_storage, tmp_path, monkeypatch):
+    """ADVICE r2: a TLS-enabled storage server must be reachable from the
+    `remote` backend via an https:// URL (scheme honored, not stripped)."""
+    cert = tmp_path / "srv.crt"
+    key = tmp_path / "srv.key"
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost",
+             "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+            check=True, capture_output=True, timeout=60)
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("openssl unavailable")
+    monkeypatch.setenv("PIO_SSL_CERTFILE", str(cert))
+    monkeypatch.setenv("PIO_SSL_KEYFILE", str(key))
+
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.remote import StorageRPCAPI
+
+    server = make_server(StorageRPCAPI(memory_storage, key="sekrit"),
+                         "127.0.0.1", 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.delenv("PIO_SSL_CERTFILE")
+        monkeypatch.delenv("PIO_SSL_KEYFILE")
+        client = Storage(env={
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URL": f"https://127.0.0.1:{port}",
+            "PIO_STORAGE_SOURCES_R_KEY": "sekrit",
+            "PIO_STORAGE_SOURCES_R_CAFILE": str(cert),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+        })
+        from predictionio_tpu.data.storage.base import App
+        app_id = client.get_meta_data_apps().insert(App(0, "tlsapp"))
+        assert client.get_meta_data_apps().get(app_id).name == "tlsapp"
+    finally:
+        server.shutdown()
