@@ -135,6 +135,7 @@ class _Shard:
         self.buffer: List[Event] = []
         self.wal_offset = 0
         self.dirty = False  # True only after a LOCAL write (writer role)
+        self.idx_cache: Dict[int, object] = {}
         self.refresh_wal()
 
     def wal_path_for(self, seq: int) -> str:
@@ -259,16 +260,77 @@ class _Shard:
     def chunk_path(self, seq: int) -> str:
         return os.path.join(self.chunk_dir, f"chunk_{seq}.npz")
 
+    def index_path(self, seq: int) -> str:
+        return os.path.join(self.chunk_dir, f"chunk_{seq}.idx.npz")
+
     def chunk_seqs(self) -> List[int]:
         return sorted(
             int(fn[len("chunk_"):-len(".npz")])
             for fn in os.listdir(self.chunk_dir)
-            if fn.startswith("chunk_") and fn.endswith(".npz"))
+            if fn.startswith("chunk_") and fn.endswith(".npz")
+            and not fn.endswith(".idx.npz"))
+
+    def chunk_index(self, seq: int) -> Optional[Dict[str, np.ndarray]]:
+        """Memoized sidecar index for an immutable chunk; None for chunks
+        written before indexing existed (reads fall back to a full scan)."""
+        got = self.idx_cache.get(seq)
+        if got is not None:
+            return got if got is not False else None
+        path = self.index_path(seq)
+        if not os.path.exists(path):
+            self.idx_cache[seq] = False
+            return None
+        with np.load(path, allow_pickle=False) as data:
+            idx = {k: data[k] for k in data.files}
+        self.idx_cache[seq] = idx
+        return idx
+
+
+def _build_chunk_index(out: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Postings for point reads: per-chunk CSR of entity_id code -> row
+    indices (and the same for target_id), plus the chunk's event-time
+    bounds. The TPU-side analogue of the reference's entity-hash rowkey
+    prefix that makes HBase point scans bounded (HBEventsUtil.scala:84-131):
+    here the chunk is the region, the postings bound the rows touched."""
+    tms = out["time_ms"]
+    n = int(tms.shape[0])
+
+    def csr(col):
+        order = np.argsort(col, kind="stable").astype(np.int32)
+        sc = col[order]
+        codes, starts = np.unique(sc, return_index=True)
+        return (codes.astype(np.int32),
+                np.append(starts, n).astype(np.int64), order)
+
+    ec, eo, er = csr(out["entity_id"])
+    tc, to_, tr = csr(out["target_id"])
+    return {
+        "ent_codes": ec, "ent_offsets": eo, "ent_rows": er,
+        "tgt_codes": tc, "tgt_offsets": to_, "tgt_rows": tr,
+        "tmin": np.int64(tms.min() if n else 0),
+        "tmax": np.int64(tms.max() if n else 0),
+    }
+
+
+def _postings(idx: Dict[str, np.ndarray], kind: str, code: int) -> np.ndarray:
+    codes = idx[kind + "_codes"]
+    j = int(np.searchsorted(codes, code))
+    if j >= codes.shape[0] or codes[j] != code:
+        return np.empty(0, np.int32)
+    off = idx[kind + "_offsets"]
+    return idx[kind + "_rows"][off[j]: off[j + 1]]
 
 
 def _pack_extras(extras: List[str]) -> Tuple[str, np.ndarray]:
     lengths = np.asarray([len(x) for x in extras], dtype=np.int32)
     return "".join(extras), lengths
+
+
+def _write_index(sh: _Shard, seq: int, out: Dict[str, np.ndarray]) -> None:
+    path = sh.index_path(seq)
+    with open(path + ".tmp", "wb") as f:
+        np.savez(f, **_build_chunk_index(out))
+    os.replace(path + ".tmp", path)
 
 
 class EventlogEvents(Events):
@@ -419,10 +481,13 @@ class EventlogEvents(Events):
         path = sh.chunk_path(sh.next_seq)
         with open(path + ".tmp", "wb") as f:
             np.savez(f, **out)
+        _write_index(sh, sh.next_seq, out)
         # publication order is the crash-safety contract: once the chunk is
         # visible its rows are durable and its WAL is superseded (readers
         # and replay both resolve chunk-over-WAL), so removing the WAL
-        # after — even after a crash in between — never duplicates rows
+        # after — even after a crash in between — never duplicates rows.
+        # The index lands before the chunk so a visible chunk always has
+        # its sidecar (an orphan index from a crash here is harmless).
         os.replace(path + ".tmp", path)
         sh.buffer = []
         sh.wal_offset = 0
@@ -479,6 +544,7 @@ class EventlogEvents(Events):
             path = sh.chunk_path(sh.next_seq)
             with open(path + ".tmp", "wb") as f:
                 np.savez(f, **out)
+            _write_index(sh, sh.next_seq, out)
             os.replace(path + ".tmp", path)
             sh.next_seq += 1
             sh.dirty = False
@@ -579,6 +645,7 @@ class EventlogEvents(Events):
         limit: Optional[int] = None,
         reversed_: bool = False,
     ) -> Iterator[Event]:
+        from predictionio_tpu.data.storage.base import NONE_FILTER
         with self._lock:
             sh = self._shard(app_id, channel_id)
             self._refresh(sh)
@@ -588,29 +655,109 @@ class EventlogEvents(Events):
                 event_names=event_names,
                 target_entity_type=target_entity_type,
                 target_entity_id=target_entity_id)
+            want = limit if (limit is not None and limit >= 0) else None
+            start_ms = _millis(start_time) if start_time is not None else None
+            until_ms = _millis(until_time) if until_time is not None else None
+            # point-filter codes for the postings pre-filter (-2 = filter on
+            # a string the dictionary has never seen -> matches nothing)
+            ent_code = (sh.codes.get(entity_id, -2)
+                        if entity_id is not None else None)
+            if target_entity_id is None:
+                tgt_code = None
+            elif target_entity_id == NONE_FILTER:
+                tgt_code = -1  # stored code for "no target entity"
+            else:
+                tgt_code = sh.codes.get(target_entity_id, -2)
+
+            # unflushed rows first, so the early-exit bound accounts for them
             matches: List[Event] = []
-            for seq in sh.chunk_seqs():
+            for row, e in enumerate(sh.buffer):
+                eid = f"{sh.token}-{sh.next_seq}-{row}"
+                if eid in sh.tombstones:
+                    continue
+                if event_matches(e, **full_filter):
+                    matches.append(e.with_event_id(eid))
+
+            # chunk visit order enables pruning: ascending by tmin (or
+            # descending by tmax when reversed_); un-indexed legacy chunks
+            # sort first so a later break never skips one
+            chunks = [(seq, sh.chunk_index(seq)) for seq in sh.chunk_seqs()]
+            if reversed_:
+                chunks.sort(key=lambda si: (
+                    -int(si[1]["tmax"]) if si[1] is not None else -(1 << 62)))
+            else:
+                chunks.sort(key=lambda si: (
+                    int(si[1]["tmin"]) if si[1] is not None else -(1 << 62)))
+
+            for seq, idx in chunks:
+                if idx is not None:
+                    tmin, tmax = int(idx["tmin"]), int(idx["tmax"])
+                    # time-range pruning
+                    if until_ms is not None and tmin >= until_ms:
+                        continue
+                    if start_ms is not None and tmax < start_ms:
+                        continue
+                    # limit pruning: once `want` events are collected, a
+                    # chunk strictly beyond the k-th best timestamp (and,
+                    # by the visit order, every later chunk) is irrelevant
+                    if want is not None and len(matches) >= want:
+                        matches.sort(key=lambda e: e.event_time,
+                                     reverse=reversed_)
+                        matches = matches[:max(want, 1)]
+                        bound = _millis(matches[want - 1].event_time)
+                        if not reversed_ and tmin > bound:
+                            break
+                        if reversed_ and tmax < bound:
+                            break
                 with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
-                    mask = np.ones(data["event"].shape[0], dtype=bool)
-                    if start_time is not None:
-                        mask &= data["time_ms"] >= _millis(start_time)
-                    if until_time is not None:
-                        mask &= data["time_ms"] < _millis(until_time)
+                    n = data["event"].shape[0]
+                    rows = None
+                    if idx is not None and (ent_code is not None
+                                            or tgt_code is not None):
+                        if ent_code is not None:
+                            rows = _postings(idx, "ent", ent_code)
+                        if tgt_code is not None:
+                            t_rows = _postings(idx, "tgt", tgt_code)
+                            rows = (t_rows if rows is None else
+                                    np.intersect1d(rows, t_rows,
+                                                   assume_unique=True))
+                        if rows.shape[0] == 0:
+                            continue
+                        rows = np.sort(rows)
+                    if rows is None:
+                        mask = np.ones(n, dtype=bool)
+                    else:
+                        mask = None  # vectorized residual over `rows` only
+                    tms = data["time_ms"] if rows is None else \
+                        data["time_ms"][rows]
+                    sub = np.ones(tms.shape[0], dtype=bool)
+                    if start_ms is not None:
+                        sub &= tms >= start_ms
+                    if until_ms is not None:
+                        sub &= tms < until_ms
                     if event_names is not None:
                         codes = [sh.codes[nm] for nm in event_names
                                  if nm in sh.codes]
-                        mask &= np.isin(data["event"], codes)
+                        col = data["event"] if rows is None else \
+                            data["event"][rows]
+                        sub &= np.isin(col, codes)
                     if entity_type is not None:
                         c = sh.codes.get(entity_type, -2)
-                        mask &= data["entity_type"] == c
-                    if entity_id is not None:
-                        c = sh.codes.get(entity_id, -2)
-                        mask &= data["entity_id"] == c
+                        col = data["entity_type"] if rows is None else \
+                            data["entity_type"][rows]
+                        sub &= col == c
+                    if entity_id is not None and rows is None:
+                        sub &= data["entity_id"] == sh.codes.get(
+                            entity_id, -2)
+                    final_rows = (np.nonzero(sub)[0] if rows is None
+                                  else rows[sub])
+                    if final_rows.shape[0] == 0:
+                        continue
                     offsets = np.concatenate(
                         [[0], np.cumsum(data["extra_len"])[:-1]])
                     for e in (self._materialize(sh, seq, data, int(row),
                                                 offsets)
-                              for row in np.nonzero(mask)[0]):
+                              for row in final_rows):
                         # residual filters (target Some(None) semantics)
                         # via the shared reference matcher
                         if e.event_id in sh.tombstones:
@@ -619,15 +766,9 @@ class EventlogEvents(Events):
                                 e, target_entity_type=target_entity_type,
                                 target_entity_id=target_entity_id):
                             matches.append(e)
-            for row, e in enumerate(sh.buffer):
-                eid = f"{sh.token}-{sh.next_seq}-{row}"
-                if eid in sh.tombstones:
-                    continue
-                if event_matches(e, **full_filter):
-                    matches.append(e.with_event_id(eid))
             matches.sort(key=lambda e: e.event_time, reverse=reversed_)
-            if limit is not None and limit >= 0:
-                matches = matches[:limit]
+            if want is not None:
+                matches = matches[:want]
             return iter(matches)
 
     # -- bulk columnar read (the TPU ingestion path) -------------------------
