@@ -164,6 +164,37 @@ def prepare_ratings(
 # ---------------------------------------------------------------------------
 # Device kernels
 # ---------------------------------------------------------------------------
+#
+# Two interchangeable Gram accumulators (A/B-testable via the trainers'
+# kernel= param / PIO_ALS_KERNEL env var):
+#
+#   "csrb" (default) — row-aligned mini-block layout + wide-row gather.
+#       Each row's entries are padded to a multiple of b (=32) so every
+#       mini-block of b consecutive entries belongs to exactly ONE row.
+#       Per half-step the opposite factors are expanded ONCE into
+#       X = [v ⊗ v | v]  (n_other, r²+r)  — the flattened outer product
+#       depends only on the column, never the pair — and the kernel
+#       gathers full 440-byte rows of X (86% of a 512B HBM transaction,
+#       vs 8% when gathering bare (r,) factor rows), scales by the two
+#       per-entry coefficients, and block-reduces to one partial per
+#       mini-block. The only scatter left is the mini-block combine:
+#       ~nnz/b sorted segment-sum updates instead of nnz. Measured on a
+#       v5e at 20M nnz / rank 10: 78 ms per side vs 390 ms for "scan"
+#       (and vs ~1.35 s/iter end-to-end in round 3).
+#
+#   "scan" — the round-2/3 kernel: chunked gather + in-loop flattened
+#       outer products + per-entry sorted segment_sum with the full
+#       (n_self+1, r²+r) accumulator riding the scan carry. Kept for A/B
+#       and as the reference implementation for parity tests.
+
+
+def _kernel_flag(kernel: Optional[str]) -> str:
+    import os
+    k = kernel or os.environ.get("PIO_ALS_KERNEL", "csrb")
+    if k not in ("csrb", "scan"):
+        raise ValueError(f"unknown ALS kernel {k!r} (want 'csrb' or 'scan')")
+    return k
+
 
 def gram_rhs(
     other_factors: jnp.ndarray,  # (n_other, r)
@@ -227,11 +258,104 @@ def gram_rhs(
     return A, b
 
 
+def csrb_layout(other_idx: jnp.ndarray, rating: jnp.ndarray,
+                counts: jnp.ndarray, n_self: int, b: int, n_mb: int):
+    """Row-sorted COO -> row-aligned mini-block layout (traceable).
+
+    Every mini-block of b consecutive slots belongs to exactly one row, so
+    per-mini-block partial Grams need no per-entry scatter. Pure gather
+    construction (no scatter): each destination slot computes its source
+    entry from the row cumsums. Returns (other_idx_p, rating_p, present_p)
+    of shape (n_mb*b,) and mb_seg (n_mb,) with dummy row n_self for padding
+    blocks past the real data.
+    """
+    counts = counts.astype(jnp.int32)
+    mbc = -(-counts // b)                       # mini-blocks per row
+    cum_mb = jnp.cumsum(mbc)                    # inclusive
+    row_start = jnp.cumsum(counts) - counts     # exclusive entry offsets
+    mb_index = jnp.arange(n_mb, dtype=jnp.int32)
+    mb_seg = jnp.searchsorted(cum_mb, mb_index, side="right").astype(jnp.int32)
+    row = jnp.repeat(mb_seg, b, total_repeat_length=n_mb * b)
+    rowc = jnp.minimum(row, n_self - 1)
+    start_pad = (jnp.take(cum_mb, rowc) - jnp.take(mbc, rowc)) * b
+    off = jnp.arange(n_mb * b, dtype=jnp.int32) - start_pad
+    valid = (row < n_self) & (off >= 0) & (off < jnp.take(counts, rowc))
+    src = jnp.clip(jnp.take(row_start, rowc) + off, 0, other_idx.shape[0] - 1)
+    o = jnp.where(valid, jnp.take(other_idx, src), 0)
+    rr = jnp.where(valid, jnp.take(rating, src), 0.0)
+    return o, rr, valid.astype(jnp.float32), mb_seg
+
+
+def gram_rhs_csrb(
+    other_factors: jnp.ndarray,  # (n_other, r)
+    other_idx: jnp.ndarray,      # (n_mb*b,) csrb layout
+    coeff_a: jnp.ndarray,        # (n_mb*b,) per-entry Gram weight
+    coeff_b: jnp.ndarray,        # (n_mb*b,) per-entry RHS weight
+    mb_seg: jnp.ndarray,         # (n_mb,) nondecreasing row per mini-block
+    n_self: int,
+    b: int,
+    chunk: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Wide-row-gather Gram accumulator over the csrb layout.
+
+    X = [v⊗v | v] is expanded once (the outer product depends only on the
+    gathered row), each entry gathers ONE lane-aligned (r²+r)-wide row, and
+    partials reduce within mini-blocks before a single sorted segment-sum
+    of ~nnz/b updates. See the kernel comparison note above gram_rhs.
+    """
+    r = other_factors.shape[1]
+    n_mb = mb_seg.shape[0]
+    m = max(chunk // b, 1)
+    n_chunks = max(n_mb // m, 1)
+    w = r * r + r
+    X = jnp.concatenate(
+        [(other_factors[:, :, None] * other_factors[:, None, :]
+          ).reshape(-1, r * r), other_factors], axis=1)
+    mask_a = jnp.concatenate([jnp.ones((r * r,), jnp.float32),
+                              jnp.zeros((r,), jnp.float32)])
+
+    def body(_, xs):
+        o, ca, cb = xs
+        rows = jnp.take(X, o, axis=0)                       # (E, w)
+        s = ca[:, None] * mask_a[None, :] + cb[:, None] * (1 - mask_a)[None, :]
+        M = jnp.sum((rows * s).reshape(m, b, w), axis=1)    # (m, w)
+        return 0, M
+
+    _, Ms = lax.scan(body, 0, (other_idx.reshape(n_chunks, m * b),
+                               coeff_a.reshape(n_chunks, m * b),
+                               coeff_b.reshape(n_chunks, m * b)))
+    AB = jax.ops.segment_sum(Ms.reshape(n_mb, w), mb_seg,
+                             num_segments=n_self + 1,
+                             indices_are_sorted=True)[:-1]
+    return AB[:, :r * r].reshape(n_self, r, r), AB[:, r * r:]
+
+
 def solve_factors(A: jnp.ndarray, b: jnp.ndarray, reg: jnp.ndarray) -> jnp.ndarray:
-    """Batched SPD solve: (A + reg I) x = b over leading axis."""
+    """Batched SPD solve: (A + reg I) x = b over leading axis.
+
+    Small ranks use an unrolled vectorized Gauss-Jordan: r fully-parallel
+    elementwise sweeps over the (n, r, r) batch. Pivoting is unnecessary —
+    A is PSD and reg > 0 keeps every Schur-complement diagonal positive.
+    Batched LAPACK-style LU (jnp.linalg.solve) serializes badly on TPU:
+    measured 377 ms vs 8.6 ms for this sweep at (138k, 10, 10) on a v5e.
+    """
     r = A.shape[-1]
     A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)[None]
-    return jnp.linalg.solve(A, b[..., None])[..., 0]
+    if r > 32:
+        return jnp.linalg.solve(A, b[..., None])[..., 0]
+    M = jnp.concatenate([A, b[..., None]], axis=2)      # (n, r, r+1)
+    for k in range(r):
+        piv = M[:, k:k + 1, :] / M[:, k:k + 1, k:k + 1]
+        M = M - M[:, :, k:k + 1] * piv
+        M = M.at[:, k, :].set(piv[:, 0, :])
+    return M[:, :, r]
+
+
+def _reg_vec(counts, n_self, lambda_, reg_scaling):
+    """MLlib ALS-WR regularization: lambda * n_ratings(row) or constant."""
+    if reg_scaling == "count":
+        return lambda_ * counts.astype(jnp.float32) + _EPS
+    return jnp.full((n_self,), lambda_ + _EPS, dtype=jnp.float32)
 
 
 def _half_step_explicit(other, side_idx, side_other, side_rating, counts,
@@ -242,11 +366,110 @@ def _half_step_explicit(other, side_idx, side_other, side_rating, counts,
     present = (side_idx < n_self).astype(jnp.float32)
     A, b = gram_rhs(other, side_idx, side_other, present, side_rating,
                     n_self, chunk)
-    if reg_scaling == "count":
-        reg = lambda_ * counts.astype(jnp.float32) + _EPS
-    else:
-        reg = jnp.full((n_self,), lambda_ + _EPS, dtype=jnp.float32)
-    return solve_factors(A, b, reg)
+    return solve_factors(A, b, _reg_vec(counts, n_self, lambda_, reg_scaling))
+
+
+def _half_step_explicit_csrb(other, oi, rat, pres, seg, counts, n_self,
+                             lambda_, b, chunk, reg_scaling):
+    # rat is 0 in padding slots (and a genuine 0.0 rating contributes 0 to
+    # the RHS anyway); presence carries the Gram weight.
+    A, rhs = gram_rhs_csrb(other, oi, pres, rat, seg, n_self, b, chunk)
+    return solve_factors(A, rhs, _reg_vec(counts, n_self, lambda_, reg_scaling))
+
+
+def _half_step_implicit_csrb(other, oi, rat, pres, seg, counts, n_self,
+                             lambda_, alpha, b, chunk, reg_scaling):
+    # Hu-Koren-Volinsky (see _half_step_implicit); padding slots have rat=0
+    # so conf=0 and pref=0 — they contribute to neither term.
+    YtY = other.T @ other
+    conf = alpha * jnp.abs(rat)
+    pref = (rat > 0).astype(jnp.float32)
+    A_corr, rhs = gram_rhs_csrb(other, oi, conf, (1.0 + conf) * pref,
+                                seg, n_self, b, chunk)
+    return solve_factors(YtY[None] + A_corr, rhs,
+                         _reg_vec(counts, n_self, lambda_, reg_scaling))
+
+
+_CSRB_B = 32  # mini-block size; 32 keeps row padding ~10-20% at ML-20M skew
+
+
+def _csrb_plan(nnz: int, n_self: int, b: int, chunk: int) -> Tuple[int, int]:
+    """(n_mb, chunk_eff): static mini-block count + scan chunk, shrunk for
+    tiny inputs so tests don't pad 100 entries to a 2^18 slab."""
+    raw = max((nnz + n_self * (b - 1) + b - 1) // b, 1)
+    m = max(chunk // b, 1)
+    m = min(m, 1 << (raw - 1).bit_length())
+    n_mb = ((raw + m - 1) // m) * m
+    return n_mb, m * b
+
+
+_csrb_layout_jit = partial(
+    jax.jit, static_argnames=("n_self", "b", "n_mb"))(csrb_layout)
+
+
+def _csrb_side(side: COOSide, b: int, chunk: int, nnz: int):
+    """Build the csrb layout for one orientation (device, jitted once)."""
+    n_mb, chunk_eff = _csrb_plan(nnz, side.n_self, b, chunk)
+    oi, rat, pres, seg = _csrb_layout_jit(
+        side.other_idx, side.rating, side.counts,
+        n_self=side.n_self, b=b, n_mb=n_mb)
+    return oi, rat, pres, seg, chunk_eff
+
+
+@partial(jax.jit, static_argnames=(
+    "n_users", "n_items", "b", "u_chunk", "i_chunk", "reg_scaling",
+    "implicit"))
+def _train_csrb_jit(
+    u_oi, u_rat, u_pres, u_seg, u_counts,
+    i_oi, i_rat, i_pres, i_seg, i_counts,
+    U0, V0,
+    iterations, lambda_: float, alpha: float,
+    n_users: int, n_items: int, b: int, u_chunk: int, i_chunk: int,
+    reg_scaling: str, implicit: bool,
+):
+    # iterations is traced: one compiled program serves any count
+    def one_iter(_, UV):
+        U, V = UV
+        if implicit:
+            U = _half_step_implicit_csrb(
+                V, u_oi, u_rat, u_pres, u_seg, u_counts, n_users,
+                lambda_, alpha, b, u_chunk, reg_scaling)
+            V = _half_step_implicit_csrb(
+                U, i_oi, i_rat, i_pres, i_seg, i_counts, n_items,
+                lambda_, alpha, b, i_chunk, reg_scaling)
+        else:
+            U = _half_step_explicit_csrb(
+                V, u_oi, u_rat, u_pres, u_seg, u_counts, n_users,
+                lambda_, b, u_chunk, reg_scaling)
+            V = _half_step_explicit_csrb(
+                U, i_oi, i_rat, i_pres, i_seg, i_counts, n_items,
+                lambda_, b, i_chunk, reg_scaling)
+        return (U, V)
+
+    return lax.fori_loop(0, iterations, one_iter, (U0, V0))
+
+
+def _run_csrb(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
+              reg_scaling, implicit, u0, v0, checkpoint_every, checkpointer):
+    """Shared csrb-kernel driver for both public trainers."""
+    b = _CSRB_B
+    bu, bi = data.by_user, data.by_item
+    u_oi, u_rat, u_pres, u_seg, u_chunk = _csrb_side(bu, b, chunk, data.nnz)
+    i_oi, i_rat, i_pres, i_seg, i_chunk = _csrb_side(bi, b, chunk, data.nnz)
+    if u0 is None or v0 is None:
+        u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
+
+    def run(u, v, n_iters):
+        return _train_csrb_jit(
+            u_oi, u_rat, u_pres, u_seg, bu.counts,
+            i_oi, i_rat, i_pres, i_seg, bi.counts,
+            u, v, iterations=n_iters, lambda_=float(lambda_),
+            alpha=float(alpha), n_users=data.n_users, n_items=data.n_items,
+            b=b, u_chunk=u_chunk, i_chunk=i_chunk,
+            reg_scaling=reg_scaling, implicit=implicit)
+
+    return _run_segmented(run, u0, v0, iterations, checkpoint_every,
+                          checkpointer)
 
 
 def init_factors(key, n: int, rank: int) -> jnp.ndarray:
@@ -336,6 +559,7 @@ def train_explicit(
     v0=None,
     checkpoint_every: Optional[int] = None,
     checkpointer=None,
+    kernel: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """ALS.train parity (defaults = recommendation-engine engine.json:14-17).
 
@@ -345,8 +569,13 @@ def train_explicit(
     save(step, {...}) / latest() -> (step, {...}) | None), training runs
     in compiled segments and snapshots factors between them — the
     iteration-level resume the reference lacks (SURVEY.md §5
-    checkpoint/resume).
+    checkpoint/resume). kernel selects the Gram accumulator ("csrb"
+    default, "scan" legacy; PIO_ALS_KERNEL overrides).
     """
+    if _kernel_flag(kernel) == "csrb":
+        return _run_csrb(data, rank, iterations, lambda_, 0.0, seed, chunk,
+                         reg_scaling, False, u0, v0, checkpoint_every,
+                         checkpointer)
     bu, bi = data.by_user, data.by_item
     chunk = min(chunk, bu.self_idx.shape[0], bi.self_idx.shape[0])
     if u0 is None or v0 is None:
@@ -423,13 +652,18 @@ def train_implicit(
     v0=None,
     checkpoint_every: Optional[int] = None,
     checkpointer=None,
+    kernel: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """ALS.trainImplicit parity (similarproduct/ecommerce templates).
 
     `rating` carries the implicit preference weight (view counts etc.);
     padding rows have weight 0 so they contribute nothing. Checkpoint
-    semantics match train_explicit.
+    semantics match train_explicit; kernel as in train_explicit.
     """
+    if _kernel_flag(kernel) == "csrb":
+        return _run_csrb(data, rank, iterations, lambda_, alpha, seed, chunk,
+                         reg_scaling, True, u0, v0, checkpoint_every,
+                         checkpointer)
     bu, bi = data.by_user, data.by_item
     chunk = min(chunk, bu.self_idx.shape[0], bi.self_idx.shape[0])
     if u0 is None or v0 is None:

@@ -38,8 +38,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.als import (
-    ALSData, COOSide, _half_step_explicit, _half_step_implicit,
-    _run_segmented, _seed_factors,
+    ALSData, COOSide, _CSRB_B, _csrb_plan, _half_step_explicit,
+    _half_step_explicit_csrb, _half_step_implicit, _half_step_implicit_csrb,
+    _kernel_flag, _run_segmented, _seed_factors, csrb_layout,
 )
 
 
@@ -157,27 +158,68 @@ def _train_sharded(
     v0,
     checkpoint_every: Optional[int],
     checkpointer,
+    kernel: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
     su, si = prepare_sharded(data, n_dev, chunk)
+    csrb = _kernel_flag(kernel) == "csrb"
+    b = _CSRB_B
+    # per-device csrb plans (static: nnz_dev is the max-padded per-device
+    # entry count, rows_dev the per-device row-slot count)
+    u_mb, u_chunk = _csrb_plan(su.nnz_dev, su.rows_dev, b, chunk)
+    i_mb, i_chunk = _csrb_plan(si.nnz_dev, si.rows_dev, b, chunk)
     half = _half_step_implicit if implicit else _half_step_explicit
 
-    def step_fn(us, uo, ur, uc, is_, io, ir, ic, U0_blk, V0_blk, n_iters):
+    def step_fn(*args):
         # Everything below runs per-device on (nnz_dev,) local slices.
+        # csrb reconstructs row ids from counts, so the self_idx arrays are
+        # neither shipped nor held in HBM on that path.
+        if csrb:
+            uo, ur, uc, io, ir, ic, U0_blk, V0_blk, n_iters = args
+            us = is_ = None
+        else:
+            us, uo, ur, uc, is_, io, ir, ic, U0_blk, V0_blk, n_iters = args
         U = lax.all_gather(U0_blk, axis, tiled=True)
         V = lax.all_gather(V0_blk, axis, tiled=True)
 
+        if csrb:
+            # layout once per compiled segment, reused by every iteration;
+            # entries are sorted by local row with end padding, exactly the
+            # precondition csrb_layout shares with the single-device path
+            u_lay = csrb_layout(uo, ur, uc, su.rows_dev, b, u_mb)
+            i_lay = csrb_layout(io, ir, ic, si.rows_dev, b, i_mb)
+
         def one_iter(_, UV):
             U, V = UV
-            if implicit:
+            if csrb:
+                oi_, rat_, pres_, seg_ = u_lay
+                if implicit:
+                    U_blk = _half_step_implicit_csrb(
+                        V, oi_, rat_, pres_, seg_, uc, su.rows_dev,
+                        lambda_, alpha, b, u_chunk, reg_scaling)
+                else:
+                    U_blk = _half_step_explicit_csrb(
+                        V, oi_, rat_, pres_, seg_, uc, su.rows_dev,
+                        lambda_, b, u_chunk, reg_scaling)
+            elif implicit:
                 U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_, alpha,
                              chunk=chunk, reg_scaling=reg_scaling)
             else:
                 U_blk = half(V, us, uo, ur, uc, su.rows_dev, lambda_,
                              chunk=chunk, reg_scaling=reg_scaling)
             U = lax.all_gather(U_blk, axis, tiled=True)
-            if implicit:
+            if csrb:
+                oi_, rat_, pres_, seg_ = i_lay
+                if implicit:
+                    V_blk = _half_step_implicit_csrb(
+                        U, oi_, rat_, pres_, seg_, ic, si.rows_dev,
+                        lambda_, alpha, b, i_chunk, reg_scaling)
+                else:
+                    V_blk = _half_step_explicit_csrb(
+                        U, oi_, rat_, pres_, seg_, ic, si.rows_dev,
+                        lambda_, b, i_chunk, reg_scaling)
+            elif implicit:
                 V_blk = half(U, is_, io, ir, ic, si.rows_dev, lambda_, alpha,
                              chunk=chunk, reg_scaling=reg_scaling)
             else:
@@ -193,11 +235,16 @@ def _train_sharded(
         V_blk = lax.dynamic_slice_in_dim(V, idx * si.rows_dev, si.rows_dev)
         return U_blk, V_blk
 
+    if csrb:
+        side_arrays = (su.other_idx, su.rating, su.counts,
+                       si.other_idx, si.rating, si.counts)
+    else:
+        side_arrays = (su.self_idx, su.other_idx, su.rating, su.counts,
+                       si.self_idx, si.other_idx, si.rating, si.counts)
     sharded = jax.shard_map(
         step_fn, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis),
-                  P(axis), P(axis), P(axis), P(axis),
-                  P(axis, None), P(axis, None), P()),
+        in_specs=tuple([P(axis)] * len(side_arrays))
+        + (P(axis, None), P(axis, None), P()),
         out_specs=(P(axis, None), P(axis, None)),
         check_vma=False,
     )
@@ -205,10 +252,7 @@ def _train_sharded(
 
     flat_spec = NamedSharding(mesh, P(axis))
     row_spec = NamedSharding(mesh, P(axis, None))
-    flat = tuple(
-        jax.device_put(a, flat_spec)
-        for a in (su.self_idx, su.other_idx, su.rating, su.counts,
-                  si.self_idx, si.other_idx, si.rating, si.counts))
+    flat = tuple(jax.device_put(a, flat_spec) for a in side_arrays)
 
     if u0 is None or v0 is None:
         u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
@@ -237,6 +281,7 @@ def train_explicit_sharded(
     v0=None,
     checkpoint_every: Optional[int] = None,
     checkpointer=None,
+    kernel: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """ALS.train over `mesh`'s single axis, nnz-balanced blocks.
 
@@ -244,11 +289,13 @@ def train_explicit_sharded(
     caller-side unpadding. Checkpoint semantics and snapshot format match
     ops.als.train_explicit exactly (shared `_run_segmented`), so a run can
     move between the single-device and sharded paths across restores.
+    kernel selects the per-device Gram accumulator (ops.als kernels).
     """
     return _train_sharded(
         mesh, data, rank, iterations, lambda_, seed, chunk, reg_scaling,
         implicit=False, alpha=0.0, u0=u0, v0=v0,
-        checkpoint_every=checkpoint_every, checkpointer=checkpointer)
+        checkpoint_every=checkpoint_every, checkpointer=checkpointer,
+        kernel=kernel)
 
 
 def train_implicit_sharded(
@@ -265,10 +312,12 @@ def train_implicit_sharded(
     v0=None,
     checkpoint_every: Optional[int] = None,
     checkpointer=None,
+    kernel: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """ALS.trainImplicit (Hu-Koren-Volinsky) over the mesh; see
     train_explicit_sharded for layout/checkpoint semantics."""
     return _train_sharded(
         mesh, data, rank, iterations, lambda_, seed, chunk, reg_scaling,
         implicit=True, alpha=alpha, u0=u0, v0=v0,
-        checkpoint_every=checkpoint_every, checkpointer=checkpointer)
+        checkpoint_every=checkpoint_every, checkpointer=checkpointer,
+        kernel=kernel)

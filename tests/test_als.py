@@ -157,3 +157,45 @@ def test_rmse_helper():
                    jnp.asarray(bu.other_idx), jnp.asarray(bu.rating),
                    jnp.asarray(mask), chunk=64)
     assert float(err) < 0.01
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_csrb_kernel_matches_scan_kernel(implicit):
+    """The csrb (mini-block wide-gather) and scan (per-entry segment-sum)
+    kernels are the same math; full trains must agree to float tolerance."""
+    ui, ii, vals = make_problem(n_u=40, n_i=25, rank=4, density=0.4, seed=7)
+    if implicit:
+        vals = np.abs(vals) + 0.5
+    data = als.prepare_ratings(ui, ii, vals, 40, 25, chunk=64)
+    train = als.train_implicit if implicit else als.train_explicit
+    U1, V1 = train(data, rank=4, iterations=4, lambda_=0.05, seed=11,
+                   chunk=64, kernel="scan")
+    U2, V2 = train(data, rank=4, iterations=4, lambda_=0.05, seed=11,
+                   chunk=64, kernel="csrb")
+    np.testing.assert_allclose(np.asarray(U1), np.asarray(U2),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(V1), np.asarray(V2),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_csrb_layout_roundtrip():
+    """Every real entry appears exactly once in the csrb layout, in a
+    mini-block owned by its row; all other slots are zero-weight."""
+    ui, ii, vals = make_problem(n_u=17, n_i=9, rank=2, density=0.5, seed=3)
+    data = als.prepare_ratings(ui, ii, vals, 17, 9, chunk=32)
+    bu = data.by_user
+    b = 8
+    n_mb, _ = als._csrb_plan(data.nnz, 17, b, 32)
+    oi, rat, pres, seg = als.csrb_layout(
+        np.asarray(bu.other_idx), np.asarray(bu.rating),
+        np.asarray(bu.counts), 17, b, n_mb)
+    oi, rat, pres, seg = (np.asarray(x) for x in (oi, rat, pres, seg))
+    assert pres.sum() == data.nnz
+    rows = np.repeat(seg, b)
+    got = sorted(zip(rows[pres > 0].tolist(), oi[pres > 0].tolist(),
+                     rat[pres > 0].tolist()))
+    want = sorted(zip(ui.tolist(), ii.tolist(), vals.tolist()))
+    assert got == want
+    # padding slots carry zero weight and a nondecreasing segment map
+    assert np.all(np.diff(seg) >= 0)
+    assert np.all(rat[pres == 0] == 0.0)
