@@ -33,7 +33,10 @@ class _Handler(BaseHTTPRequestHandler):
                 method, parsed.path, query, body, dict(self.headers.items()))
         except Exception as e:  # handler without its own guard
             status, payload = 500, {"message": str(e)}
-        if isinstance(payload, str):  # pre-rendered HTML (dashboard pages)
+        if isinstance(payload, (bytes, bytearray)):  # binary (storage RPC)
+            data = bytes(payload)
+            ctype = "application/octet-stream"
+        elif isinstance(payload, str):  # pre-rendered HTML (dashboard pages)
             data = payload.encode("utf-8")
             ctype = "text/html; charset=UTF-8"
         else:

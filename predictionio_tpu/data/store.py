@@ -246,12 +246,19 @@ def find_columnar(
     events_dao = storage.get_events()
     if hasattr(events_dao, "read_columns"):
         app_id, channel_id = _resolve_app(app_name, channel_name, storage)
-        cols = events_dao.read_columns(
-            app_id, channel_id, event_names=event_names,
-            entity_type=entity_type, target_entity_type=target_entity_type,
-            rating_property=rating_property)
-        return _columnar_from_codes(cols, event_names, entity_vocab,
-                                    target_vocab)
+        try:
+            cols = events_dao.read_columns(
+                app_id, channel_id, event_names=event_names,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                rating_property=rating_property)
+        except NotImplementedError:
+            # a remote driver whose BACKING store has no columnar support
+            # reports it this way; fall through to the per-event path
+            cols = None
+        if cols is not None:
+            return _columnar_from_codes(cols, event_names, entity_vocab,
+                                        target_vocab)
     events = find(
         app_name, channel_name=channel_name, event_names=event_names,
         entity_type=entity_type, target_entity_type=target_entity_type,

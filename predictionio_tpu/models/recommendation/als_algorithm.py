@@ -85,6 +85,15 @@ class ALSAlgorithm(Algorithm):
                 # single-device: sort/pad in HBM; mesh path re-partitions
                 # on host
                 device=not use_mesh)
+            if not isinstance(data.by_user.self_idx, np.ndarray):
+                # tunneled platforms (axon) can return from
+                # block_until_ready before results land; fetching one
+                # element forces the in-HBM sort so the layout phase owns
+                # its wall-clock instead of leaking into train
+                import jax
+
+                jax.device_get((data.by_user.self_idx[-1:],
+                                data.by_item.self_idx[-1:]))
         checkpointer = None
         ckpt_dir = getattr(ctx, "checkpoint_dir", None)
         if self.ap.checkpointInterval and ckpt_dir:
@@ -108,7 +117,9 @@ class ALSAlgorithm(Algorithm):
                 checkpointer=checkpointer)
         import jax
 
-        jax.block_until_ready((U, V))  # train phase owns its wall-clock
+        # train phase owns its wall-clock: a one-row fetch forces both
+        # factor buffers even where block_until_ready is unreliable (axon)
+        jax.device_get((U[-1:], V[-1:]))
         return ALSModel(
             rank=self.ap.rank, user_factors=U, item_factors=V,
             user_vocab=td.user_vocab, item_vocab=td.item_vocab)

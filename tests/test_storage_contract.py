@@ -288,3 +288,73 @@ def test_default_env_uses_sqlite(tmp_path, monkeypatch):
     storage = Storage(env={"PIO_FS_BASEDIR": str(tmp_path / "store")})
     storage.verify_all_data_objects()
     assert (tmp_path / "store" / "pio.sqlite").exists()
+
+
+def test_remote_columnar_and_binary_models(tmp_path):
+    """remote driver fast paths: read_columns rides the binary npz route
+    (JDBCPEvents.scala:91-150 role), model blobs ride raw octet routes
+    (S3Models.scala:36-95 role), find pages instead of one giant reply."""
+    import numpy as np
+
+    from predictionio_tpu.data.storage.base import Model
+    from predictionio_tpu.data.storage.remote import serve_storage
+
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_B_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path / "el"),
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    server = serve_storage(backing, host="127.0.0.1", port=0, key="k2")
+    port = server.server_address[1]
+    try:
+        remote = Storage(env={
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+            "PIO_STORAGE_SOURCES_R_KEY": "k2",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+        })
+        ev = remote.get_events()
+        ev.init(7)
+        events = [Event(event="rate", entity_type="user", entity_id=f"u{k%5}",
+                        target_entity_type="item", target_entity_id=f"i{k%3}",
+                        properties=DataMap({"rating": float(k % 5) + 1.0}),
+                        event_time=t(k))
+                  for k in range(30)]
+        ev.insert_batch(events, 7)
+
+        # columnar bulk read over the binary route
+        cols = ev.read_columns(7, event_names=["rate"], entity_type="user",
+                               target_entity_type="item")
+        assert int(np.sum(cols["event_code"] >= 0)) == 30
+        pool = cols["pool"]
+        got = sorted(
+            (pool[e], pool[t], float(r))
+            for e, t, r in zip(cols["entity_code"], cols["target_code"],
+                               cols["rating"]))
+        want = sorted((f"u{k%5}", f"i{k%3}", float(k % 5) + 1.0)
+                      for k in range(30))
+        assert got == want
+
+        # find pages across boundaries (force a tiny page size)
+        ev.PAGE = 7
+        found = list(ev.find(app_id=7))
+        assert len(found) == 30
+        limited = list(ev.find(app_id=7, limit=13))
+        assert len(limited) == 13
+
+        # binary model blobs round-trip raw (8 MB, incompressible)
+        blob = np.random.default_rng(0).integers(
+            0, 256, 8 << 20, dtype=np.uint8).tobytes()
+        models = remote.get_model_data_models()
+        models.insert(Model(id="big/one?x=1", models=blob))
+        back = models.get("big/one?x=1")
+        assert back is not None and back.models == blob
+        assert models.get("missing") is None
+
+    finally:
+        server.shutdown()
