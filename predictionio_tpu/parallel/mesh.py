@@ -20,6 +20,33 @@ def local_device_count() -> int:
     return len(jax.devices())
 
 
+def init_distributed(coordinator: str, num_processes: int,
+                     process_id: int) -> None:
+    """Join a multi-host training job (SURVEY.md §2.7 DCN scale-out).
+
+    The reference scales out by spawning against a Spark cluster
+    (tools/.../Runner.scala:185-307); the TPU-native equivalent is JAX's
+    multi-controller runtime: every host runs the SAME `pio train`
+    invocation with its own --process-id, jax.distributed.initialize
+    wires them through the coordinator, and jax.devices() then returns
+    the GLOBAL device set so get_mesh() spans all hosts — collectives
+    ride ICI within a slice and DCN across slices, inserted by XLA.
+    Idempotent: repeat calls with the same topology are no-ops.
+    """
+    if getattr(init_distributed, "_done", None) == (
+            coordinator, num_processes, process_id):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id)
+    init_distributed._done = (coordinator, num_processes, process_id)
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
 def get_mesh(n_devices: Optional[int] = None,
              axis_name: str = "block") -> Mesh:
     """A 1-D mesh over the first n devices (default: all).

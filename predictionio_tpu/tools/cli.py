@@ -74,22 +74,48 @@ def _load_engine_and_params(args):
 
 
 def _make_context(batch: str = "", devices: int = 0,
-                  profile_dir: Optional[str] = None):
+                  profile_dir: Optional[str] = None,
+                  coordinator: str = "", num_processes: int = 0,
+                  process_id: int = 0):
     from predictionio_tpu.workflow import WorkflowContext, WorkflowParams
     mesh = None
-    if devices and devices > 1:
+    if coordinator:
+        # multi-host job (Runner.scala:185-307 role): every host runs the
+        # same command with its own --process-id; after initialize,
+        # jax.devices() is the GLOBAL device set, so the mesh below spans
+        # all hosts and XLA routes collectives over ICI/DCN
+        from predictionio_tpu.parallel.mesh import init_distributed
+        init_distributed(coordinator, num_processes, process_id)
+        if not devices:
+            devices = -1  # default to the whole global mesh
+    if devices and (devices > 1 or devices < 0):
         from predictionio_tpu.parallel.mesh import get_mesh
-        mesh = get_mesh(devices)
+        mesh = get_mesh(None if devices < 0 else devices)
     return WorkflowContext(
         workflow_params=WorkflowParams(batch=batch, profile_dir=profile_dir),
         mesh=mesh)
 
 
 def cmd_train(args) -> int:
+    if getattr(args, "coordinator", ""):
+        if args.num_processes < 1:
+            _error("--coordinator requires --num-processes >= 1")
+            return 1
+        if not (0 <= args.process_id < args.num_processes):
+            _error("--process-id must be in [0, --num-processes)")
+            return 1
+        # must run before ANYTHING touches the XLA backend (engine loading
+        # below may already jit) — jax.distributed.initialize requirement
+        from predictionio_tpu.parallel.mesh import init_distributed
+        init_distributed(args.coordinator, args.num_processes,
+                         args.process_id)
     from predictionio_tpu.workflow import run_train
     _engine_dir, variant, engine, engine_params = _load_engine_and_params(args)
     ctx = _make_context(batch=args.batch, devices=args.devices,
-                        profile_dir=args.profile or None)
+                        profile_dir=args.profile or None,
+                        coordinator=args.coordinator,
+                        num_processes=args.num_processes,
+                        process_id=args.process_id)
     instance_id = run_train(
         ctx, engine, engine_params,
         engine_id=variant.get("id", "default"),
@@ -381,7 +407,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "snapshots should seed this training")
     sp.add_argument("--devices", type=int, default=0,
                     help="train block-sharded over the first N devices "
-                         "(default: single-device)")
+                         "(default: single-device; -1 = all, incl. every "
+                         "host of a multi-host job)")
+    sp.add_argument("--coordinator", default="",
+                    help="host:port of process 0 for a multi-host train; "
+                         "run the same command on every host with its own "
+                         "--process-id (jax.distributed)")
+    sp.add_argument("--num-processes", type=int, default=0,
+                    help="total hosts in the multi-host job")
+    sp.add_argument("--process-id", type=int, default=0,
+                    help="this host's rank in [0, --num-processes)")
     sp.add_argument("--profile", default="",
                     help="write a jax.profiler trace to this directory")
 

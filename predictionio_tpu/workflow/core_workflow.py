@@ -49,7 +49,27 @@ def run_train(
 
     resume_from: instance id of a prior FAILED run — its iteration
     snapshots (if the algorithm checkpoints) seed this run instead of
-    starting from iteration 0."""
+    starting from iteration 0.
+
+    Multi-host: every process of a jax.distributed job calls run_train
+    (the sharded trainer's collectives need all of them), but only
+    process 0 writes the ledger row and model blob — the others train
+    and return "" (the Spark-driver-vs-executor split, SURVEY.md §2.7).
+    Iteration checkpointing is disabled UNIFORMLY on multi-host jobs:
+    per-segment snapshots would give each rank a different compiled-call
+    schedule (and resume a different restore state) unless the snapshot
+    dir were a shared filesystem, which this runtime does not assume."""
+    import jax
+    if jax.process_count() > 1:
+        if resume_from:
+            raise ValueError(
+                "resume_from is not supported on multi-host jobs: iteration "
+                "snapshots are per-host, so ranks would restore divergent "
+                "factors. Re-run the training from scratch.")
+        ctx.checkpoint_dir = None   # same single-segment schedule, all ranks
+        if jax.process_index() != 0:
+            engine.train(ctx, engine_params)
+            return ""
     storage = ctx.storage
     instances = storage.get_meta_data_engine_instances()
     import json as _json
@@ -70,7 +90,8 @@ def run_train(
     # improvement over the reference; workflow/checkpoint.py). Resuming a
     # crashed run reuses ITS directory so saved snapshots are consulted.
     from predictionio_tpu.workflow.checkpoint import run_checkpoint_dir
-    ctx.checkpoint_dir = run_checkpoint_dir(resume_from or instance_id)
+    if jax.process_count() == 1:
+        ctx.checkpoint_dir = run_checkpoint_dir(resume_from or instance_id)
     try:
         profile_dir = getattr(ctx.workflow_params, "profile_dir", None)
         if profile_dir:
@@ -103,8 +124,11 @@ def run_train(
                               for k, v in phases.items())
             logger.info("Phase wall-clock:\n%s", table)
         # the model blob persists the final state; snapshots are scratch
-        from predictionio_tpu.workflow.checkpoint import FactorCheckpointer
-        FactorCheckpointer(ctx.checkpoint_dir).clear()
+        if ctx.checkpoint_dir:
+            from predictionio_tpu.workflow.checkpoint import (
+                FactorCheckpointer,
+            )
+            FactorCheckpointer(ctx.checkpoint_dir).clear()
         return instance_id
     except Exception:
         row = instances.get(instance_id)

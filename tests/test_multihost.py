@@ -1,0 +1,115 @@
+"""Multi-host (DCN) emulation: two REAL processes, one global mesh.
+
+The reference's cluster story is spawning against a Spark cluster
+(tools/.../Runner.scala:185-307); ours is JAX's multi-controller runtime
+(parallel.mesh.init_distributed). This test proves the sharded ALS
+trainer's collectives actually cross process boundaries: two OS processes
+each own 4 virtual CPU devices, jax.distributed stitches them into one
+8-device mesh, and both must produce factors that match a single-process
+8-device run of the same seed bit-for-bit (same device count => same
+reduction order).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+out_path = sys.argv[3]
+
+# the environment preloads jax pinned to its own platform; as in
+# tests/conftest.py the backend is not initialized yet, so config applies
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from predictionio_tpu.parallel.mesh import get_mesh, init_distributed
+init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())  # 2 hosts x 4 local
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.parallel import als_dist
+
+rng = np.random.default_rng(77)           # identical data on both hosts
+n_u, n_i, nnz = 120, 60, 2500
+u = rng.integers(0, n_u, nnz).astype(np.int32)
+i = rng.integers(0, n_i, nnz).astype(np.int32)
+r = rng.uniform(0.5, 5.0, nnz).astype(np.float32)
+data = als.prepare_ratings(u, i, r, n_u, n_i)
+
+mesh = get_mesh()                          # all 8 GLOBAL devices
+U, V = als_dist.train_explicit_sharded(mesh, data, rank=5, iterations=4,
+                                       lambda_=0.05, seed=9)
+with open(out_path, "w") as f:
+    json.dump({"U": np.asarray(U).tolist(), "V": np.asarray(V).tolist(),
+               "process_count": jax.process_count()}, f)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_matches_single_process(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "PYTHONPATH": os.pathsep.join(
+               [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+               + os.environ.get("PYTHONPATH", "").split(os.pathsep)),
+           }
+    outs = [tmp_path / "out0.json", tmp_path / "out1.json"]
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), str(port), str(outs[pid])],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in (0, 1)]
+    try:
+        logs = [p.communicate(timeout=280)[0].decode(errors="replace")
+                for p in procs]
+    finally:
+        for p in procs:   # a deadlocked worker must not outlive the test
+            if p.poll() is None:
+                p.kill()
+    for pid, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {pid} failed:\n{logs[pid][-3000:]}"
+
+    got = [json.loads(o.read_text()) for o in outs]
+    assert got[0]["process_count"] == 2
+    # both processes computed (and can read) the SAME replicated factors
+    np.testing.assert_array_equal(np.asarray(got[0]["U"]),
+                                  np.asarray(got[1]["U"]))
+    np.testing.assert_array_equal(np.asarray(got[0]["V"]),
+                                  np.asarray(got[1]["V"]))
+
+    # and they match a single-process run over the same 8-device mesh
+    from predictionio_tpu.ops import als
+    from predictionio_tpu.parallel import als_dist
+    from predictionio_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(77)
+    n_u, n_i, nnz = 120, 60, 2500
+    u = rng.integers(0, n_u, nnz).astype(np.int32)
+    i = rng.integers(0, n_i, nnz).astype(np.int32)
+    r = rng.uniform(0.5, 5.0, nnz).astype(np.float32)
+    data = als.prepare_ratings(u, i, r, n_u, n_i)
+    U, V = als_dist.train_explicit_sharded(get_mesh(8), data, rank=5,
+                                           iterations=4, lambda_=0.05,
+                                           seed=9)
+    np.testing.assert_allclose(np.asarray(got[0]["U"]), np.asarray(U),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[0]["V"]), np.asarray(V),
+                               rtol=1e-5, atol=1e-6)
