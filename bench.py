@@ -161,6 +161,124 @@ def measure_http_ingest(storage, n_users, n_items,
     return n_events / dt
 
 
+def measure_eval_grid(storage, n_events: int = 100_000, n_users: int = 943,
+                      n_items: int = 1_682):
+    """The reference's default eval workload (Evaluation.scala:90-106 +
+    BASELINE.md): rank {5,10,20} x iterations {1,5,10}, 5-fold CV,
+    Precision@10, at MovieLens-100K scale, through run_evaluation with
+    FastEval memoization. Returns (wall_s, best_score, n_variants)."""
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.models.recommendation.evaluation import (
+        RecommendationEvaluation, engine_params_list,
+    )
+    from predictionio_tpu.workflow import run_evaluation
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    app_id = storage.get_meta_data_apps().insert(App(0, "BenchEval"))
+    u, i, r = synth_codes(n_users, n_items, n_events, seed=100)
+    seed_event_store(storage, app_id, u, i, r, n_users)
+
+    params = engine_params_list("BenchEval", k_fold=5, query_num=10)
+    ctx = WorkflowContext(storage=storage)
+    t0 = time.perf_counter()
+    result = run_evaluation(
+        ctx, RecommendationEvaluation(), params,
+        evaluation_class="RecommendationEvaluation")
+    wall = time.perf_counter() - t0
+    return wall, float(result.best_score.score), len(params)
+
+
+def measure_ecom_serving(storage, big_app_users: int, n_queries: int = 200):
+    """E-commerce serving with unseenOnly=true against the 20M-event log:
+    every query does LIVE seen-events + similar-events lookups
+    (ecommerce/als_algorithm.py _seen_items / predict) through the event
+    store's postings index + chunk cache. Returns (p50_ms, p99_ms)."""
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.models.ecommerce import (
+        DataSourceParams, ECommAlgorithmParams, ECommerceEngine,
+    )
+    from predictionio_tpu.workflow import run_train
+    from predictionio_tpu.workflow.create_server import QueryAPI
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    # small TRAINING app sharing the big log's user/item id space; the
+    # algorithm's appName points at the 20M log so serve-time lookups pay
+    # the real cost
+    app_id = storage.get_meta_data_apps().insert(App(0, "BenchEcom"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(7)
+    n_tu, n_ti = 1_000, 400
+    evs = [Event(event="$set", entity_type="user", entity_id=f"u{k}",
+                 properties=DataMap({})) for k in range(n_tu)]
+    evs += [Event(event="$set", entity_type="item", entity_id=f"i{k}",
+                  properties=DataMap({"categories": ["c"]}))
+            for k in range(n_ti)]
+    ev.insert_batch(evs, app_id)
+    uu = rng.integers(0, n_tu, 30_000)
+    ii = rng.integers(0, n_ti, 30_000)
+    rr = rng.integers(1, 11, 30_000) / 2.0
+    evs = [Event(event="rate", entity_type="user", entity_id=f"u{a}",
+                 target_entity_type="item", target_entity_id=f"i{b}",
+                 properties=DataMap({"rating": float(c)}))
+           for a, b, c in zip(uu, ii, rr)]
+    for lo in range(0, len(evs), 10_000):
+        ev.insert_batch(evs[lo:lo + 10_000], app_id)
+
+    engine = ECommerceEngine()
+    algo_params = ECommAlgorithmParams(
+        appName="BenchApp", unseenOnly=True, seenEvents=("rate",),
+        similarEvents=("rate",), rank=8, numIterations=3, lambda_=0.05,
+        seed=3)
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="BenchEcom"),
+        algorithm_params_list=(("ecomm", algo_params),))
+    run_train(WorkflowContext(storage=storage), engine, ep,
+              engine_factory="bench-ecom",
+              params_json={
+                  "datasource": {"params": {"appName": "BenchEcom"}},
+                  "algorithms": [{"name": "ecomm", "params": {
+                      "appName": "BenchApp", "unseenOnly": True,
+                      "seenEvents": ["rate"], "similarEvents": ["rate"],
+                      "rank": 8, "numIterations": 3, "lambda": 0.05,
+                      "seed": 3}}]})
+
+    api = QueryAPI(storage=storage, engine=engine)
+    server = make_server(api, "127.0.0.1", 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn.connect()
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        lat = []
+        for q in range(n_queries):
+            # users drawn from the BIG log's id space: live lookups hit it
+            body = json.dumps(
+                {"user": f"u{q * 131 % min(big_app_users, n_tu)}",
+                 "num": 5})
+            t0 = time.perf_counter()
+            conn.request("POST", "/queries.json", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            lat.append(time.perf_counter() - t0)
+            assert resp.status == 200, payload[:200]
+        lat_ms = np.asarray(lat) * 1e3
+        return (float(np.percentile(lat_ms, 50)),
+                float(np.percentile(lat_ms, 99)))
+    finally:
+        server.shutdown()
+
+
 def serve_and_measure(storage, engine, n_queries: int = 200):
     """Deploy via QueryAPI + HTTP and time front-door query round-trips."""
     import http.client
@@ -312,6 +430,24 @@ def main() -> None:
 
         p50_ms, p99_ms = serve_and_measure(storage, engine)
 
+        eval_grid = ecom = None
+        if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+            try:
+                ev_events = int(os.environ.get("BENCH_EVAL_EVENTS", 100_000))
+                t0 = time.perf_counter()
+                ew, best, nvar = measure_eval_grid(storage, ev_events)
+                eval_grid = {"eval_grid_s": round(ew, 3),
+                             "eval_variants": nvar,
+                             "eval_best_p_at_10": round(best, 4)}
+            except Exception as e:  # extras must never sink the headline
+                eval_grid = {"eval_error": f"{type(e).__name__}: {e}"}
+            try:
+                e50, e99 = measure_ecom_serving(storage, n_users)
+                ecom = {"ecom_unseen_p50_ms": round(e50, 3),
+                        "ecom_unseen_p99_ms": round(e99, 3)}
+            except Exception as e:
+                ecom = {"ecom_error": f"{type(e).__name__}: {e}"}
+
         published = {}
         try:
             with open(os.path.join(HERE, "BASELINE.json")) as f:
@@ -348,6 +484,8 @@ def main() -> None:
                               round(ck_b1, 2), round(ck_b2, 2)],
                 "serve_http_p50_ms": round(p50_ms, 3),
                 "serve_http_p99_ms": round(p99_ms, 3),
+                **(eval_grid or {}),
+                **(ecom or {}),
                 "device": str(jax.devices()[0]).split(":")[0],
             },
         }))

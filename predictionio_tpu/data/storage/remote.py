@@ -429,9 +429,11 @@ class StorageClient:
         if getattr(self, "_proto", None) is None:
             try:
                 status, payload = self.request_raw("GET", "/", retry=True)
-                self._proto = int(json.loads(payload).get("proto", 1)) \
-                    if status == 200 else 1
             except Exception:
+                return 1   # transient: do NOT pin; re-probe next call
+            if status == 200:
+                self._proto = int(json.loads(payload).get("proto", 1))
+            else:
                 self._proto = 1
         return self._proto
 
@@ -766,6 +768,10 @@ class RemoteModels(Models):
         self.c = client
 
     def insert(self, m: Model) -> None:
+        if self.c.proto() < 2:   # old server: legacy base64 DAO call
+            self.c.call("models", "insert", id=m.id,
+                        models=base64.b64encode(m.models).decode())
+            return
         import urllib.parse
         status, payload = self.c.request_raw(
             "POST", "/rpc/model?id=" + urllib.parse.quote(m.id), m.models)
@@ -774,11 +780,16 @@ class RemoteModels(Models):
                 f"storage server error {status}: {payload[:200]!r}")
 
     def get(self, model_id: str) -> Optional[Model]:
+        if self.c.proto() < 2:
+            d = self.c.call("models", "get", model_id=model_id)
+            if d is None:
+                return None
+            return Model(id=d["id"], models=base64.b64decode(d["models"]))
         import urllib.parse
         status, payload = self.c.request_raw(
             "GET", "/rpc/model?id=" + urllib.parse.quote(model_id),
             retry=True)
-        if status == 404:
+        if status == 404 and b"unknown route" not in payload:
             return None
         if status != 200:
             raise RuntimeError(

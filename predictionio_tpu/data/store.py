@@ -79,6 +79,34 @@ def find(
     )
 
 
+def find_target_ids(
+    app_name: str,
+    entity_type: str,
+    entity_id: str,
+    channel_name: Optional[str] = None,
+    event_names: Optional[Sequence[str]] = None,
+    target_entity_type: Optional[str] = None,
+    storage: Optional[Storage] = None,
+) -> List[str]:
+    """Target entity ids of matching events — the serving-time seen/similar
+    lookup (ECommAlgorithm.scala:148-176 uses only targetEntityId). Takes
+    the backend's columnar fast path when it has one (eventlog:
+    postings + target-code gather, no Event objects); falls back to
+    find_by_entity otherwise."""
+    storage = storage or get_storage()
+    events_dao = storage.get_events()
+    if hasattr(events_dao, "find_target_ids"):
+        app_id, channel_id = _resolve_app(app_name, channel_name, storage)
+        return events_dao.find_target_ids(
+            app_id, channel_id, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type)
+    return [e.target_entity_id for e in find_by_entity(
+        app_name, entity_type, entity_id, channel_name=channel_name,
+        event_names=event_names, target_entity_type=target_entity_type,
+        storage=storage) if e.target_entity_id is not None]
+
+
 def find_by_entity(
     app_name: str,
     entity_type: str,

@@ -143,19 +143,18 @@ class ECommAlgorithm(Algorithm):
         return getattr(self, "_serving_storage", None)
 
     def _seen_items(self, user: str) -> Set[str]:
-        """Seen events for this user, queried live (:148-176)."""
+        """Seen events for this user, queried live (:148-176) — via the
+        columnar target-id fast path (no Event materialization)."""
         if not self.ap.unseenOnly:
             return set()
         try:
-            events = store.find_by_entity(
-                app_name=self.ap.appName, entity_type="user", entity_id=user,
-                event_names=list(self.ap.seenEvents),
-                target_entity_type="item", storage=self._storage)
+            return set(store.find_target_ids(
+                app_name=self.ap.appName, entity_type="user",
+                entity_id=user, event_names=list(self.ap.seenEvents),
+                target_entity_type="item", storage=self._storage))
         except Exception as e:
             logger.error("Error when read seen events: %s", e)
             return set()
-        return {e.target_entity_id for e in events
-                if e.target_entity_id is not None}
 
     def _unavailable_items(self) -> Set[str]:
         """Latest $set on constraint/unavailableItems (:178-200)."""

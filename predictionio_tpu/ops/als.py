@@ -130,7 +130,9 @@ def prepare_ratings(
     nnz = user_idx.shape[0]
 
     if device:
-        nnz_pad = max(((nnz + chunk - 1) // chunk) * chunk, chunk)
+        # bucketed pad: a growing event log re-trains on O(log) distinct
+        # shapes instead of one new compile per chunk multiple
+        nnz_pad = bucket_units(max(-(-nnz // chunk), 1)) * chunk
         u, i, r = (jnp.asarray(user_idx), jnp.asarray(item_idx),
                    jnp.asarray(rating))
 
@@ -147,10 +149,11 @@ def prepare_ratings(
 
     def side(a_idx, b_idx, n_a, n_b) -> COOSide:
         s, o, r, counts = group_coo(a_idx, b_idx, rating, n_a)
+        pad = bucket_units(max(-(-s.shape[0] // chunk), 1)) * chunk
         return COOSide(
-            self_idx=pad_to_multiple(s, chunk, n_a),
-            other_idx=pad_to_multiple(o, chunk, 0),
-            rating=pad_to_multiple(r, chunk, 0.0),
+            self_idx=pad_to_multiple(s, pad, n_a),
+            other_idx=pad_to_multiple(o, pad, 0),
+            rating=pad_to_multiple(r, pad, 0.0),
             counts=counts, n_self=n_a, n_other=n_b,
         )
 
@@ -393,13 +396,30 @@ def _half_step_implicit_csrb(other, oi, rat, pres, seg, counts, n_self,
 _CSRB_B = 32  # mini-block size; 32 keeps row padding ~10-20% at ML-20M skew
 
 
+def bucket_units(n: int, step: float = 1.25) -> int:
+    """Round a unit count up to a geometric bucket boundary (~step ratio).
+
+    Shapes derived from nnz are jit statics, so an event log that grows a
+    little between trains would otherwise recompile the whole trainer per
+    run. Geometric buckets cap the number of distinct compiled shapes at
+    O(log_step nnz) for <= (step-1) padding overhead. Disable with
+    PIO_NNZ_BUCKETING=0 (exact shapes, maximal recompiles)."""
+    import os
+    if n <= 1 or os.environ.get("PIO_NNZ_BUCKETING", "1") == "0":
+        return max(n, 1)
+    b = 1
+    while b < n:
+        b = max(b + 1, int(b * step))
+    return b
+
+
 def _csrb_plan(nnz: int, n_self: int, b: int, chunk: int) -> Tuple[int, int]:
     """(n_mb, chunk_eff): static mini-block count + scan chunk, shrunk for
     tiny inputs so tests don't pad 100 entries to a 2^18 slab."""
     raw = max((nnz + n_self * (b - 1) + b - 1) // b, 1)
     m = max(chunk // b, 1)
     m = min(m, 1 << (raw - 1).bit_length())
-    n_mb = ((raw + m - 1) // m) * m
+    n_mb = bucket_units(((raw + m - 1) // m)) * m
     return n_mb, m * b
 
 

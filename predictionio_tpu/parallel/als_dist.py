@@ -141,8 +141,9 @@ def _shard_side(side: COOSide, n_dev: int, chunk: int) -> ShardedSide:
     bounds = np.searchsorted(
         grouped_key, np.arange(0, n_rows_pad + 1, rows_dev))
     nnz_per_dev = (bounds[1:] - bounds[:-1]).astype(np.int64)
+    from predictionio_tpu.ops.als import bucket_units
     nnz_dev = int(max(nnz_per_dev.max(), 1))
-    nnz_dev = ((nnz_dev + chunk - 1) // chunk) * chunk
+    nnz_dev = bucket_units(-(-nnz_dev // chunk)) * chunk
 
     s = np.full((n_dev, nnz_dev), rows_dev, dtype=np.int32)  # pad = dummy row
     o = np.zeros((n_dev, nnz_dev), dtype=np.int32)

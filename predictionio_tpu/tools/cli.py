@@ -254,6 +254,33 @@ def cmd_storageserver(args) -> int:
 # status / app / accesskey / template / import / export
 # ---------------------------------------------------------------------------
 
+def cmd_shell(args) -> int:
+    """Interactive shell with Storage preloaded (bin/pio-shell role —
+    the reference opens a spark-shell with pio assemblies on the
+    classpath; here the session gets the configured Storage, the store
+    facades, and jax)."""
+    import jax
+
+    from predictionio_tpu.data import store
+    from predictionio_tpu.data.storage import Storage
+
+    storage = Storage()
+    ns = {"storage": storage, "store": store, "jax": jax,
+          "Storage": Storage}
+    banner = ("predictionio_tpu shell\n"
+              "  storage  -> configured Storage (env-driven)\n"
+              "  store    -> event store facades "
+              "(find/find_columnar/aggregate_properties)\n"
+              "  jax      -> jax (devices: %s)" % (jax.devices(),))
+    try:
+        from IPython import start_ipython
+        start_ipython(argv=[], user_ns=ns, display_banner=True)
+    except ImportError:
+        import code
+        code.interact(banner=banner, local=ns)
+    return 0
+
+
 def cmd_status(args) -> int:
     """Verify installation + storage (commands/Management.scala:181,
     Storage.verifyAllDataObjects)."""
@@ -464,6 +491,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--key", default="",
                     help="require this server key (or set PIO_SERVER_KEY)")
 
+    sub.add_parser("shell", help="interactive shell with Storage "
+                   "preloaded (pio-shell)")
+
     sp = sub.add_parser("storageserver",
                         help="serve this node's storage to remote clients")
     sp.add_argument("--ip", default="0.0.0.0")
@@ -540,6 +570,7 @@ _DISPATCH = {
     "dashboard": cmd_dashboard,
     "adminserver": cmd_adminserver,
     "storageserver": cmd_storageserver,
+    "shell": cmd_shell,
     "status": cmd_status,
     "app": cmd_app,
     "accesskey": cmd_accesskey,

@@ -343,12 +343,20 @@ def test_point_read_touches_only_matching_rows(tmp_path, monkeypatch):
 
     calls = {"n": 0}
     orig = el_mod.EventlogEvents._materialize
+    orig_batch = el_mod.EventlogEvents._materialize_batch
 
     def counting(self, *a, **kw):
         calls["n"] += 1
         return orig(self, *a, **kw)
 
+    def counting_batch(self, sh, seq, data, rows, offsets):
+        out = orig_batch(self, sh, seq, data, rows, offsets)
+        calls["n"] += len(out)
+        return out
+
     monkeypatch.setattr(el_mod.EventlogEvents, "_materialize", counting)
+    monkeypatch.setattr(el_mod.EventlogEvents, "_materialize_batch",
+                        counting_batch)
     got = list(ev.find(app_id, entity_id="u7", entity_type="user"))
     assert len(got) == 15  # 5 rows per chunk x 3 chunks
     assert calls["n"] == 15  # exactly the matching rows, not 600
@@ -359,15 +367,25 @@ def test_point_read_touches_only_matching_rows(tmp_path, monkeypatch):
     assert len(got) == 36 and calls["n"] == 36
 
     # limit + reversed early-exit: only the newest chunk is opened
+    # (chunk columns are LRU-cached mmaps now, so drop the cache to count
+    # opens; both the mmap path and the np.load fallback count as one)
     loads = {"n": 0}
     orig_load = el_mod.np.load
+    orig_mmap = el_mod._mmap_npz_columns
 
     def counting_load(path, *a, **kw):
         if str(path).endswith(".npz") and "idx" not in str(path):
             loads["n"] += 1
         return orig_load(path, *a, **kw)
 
+    def counting_mmap(path):
+        loads["n"] += 1
+        return orig_mmap(path)
+
     monkeypatch.setattr(el_mod.np, "load", counting_load)
+    monkeypatch.setattr(el_mod, "_mmap_npz_columns", counting_mmap)
+    sh.col_cache.clear()
+    sh.col_cache_bytes = 0
     got = list(ev.find(app_id, entity_id="u7", entity_type="user",
                        limit=3, reversed_=True))
     assert [e.event_time for e in got] == sorted(
@@ -375,7 +393,58 @@ def test_point_read_touches_only_matching_rows(tmp_path, monkeypatch):
     assert len(got) == 3
     assert loads["n"] == 1  # later chunks pruned by the k-th-best bound
 
+    # repeating the query serves entirely from the column cache: zero I/O
+    loads["n"] = 0
+    got2 = list(ev.find(app_id, entity_id="u7", entity_type="user",
+                        limit=3, reversed_=True))
+    assert len(got2) == 3 and loads["n"] == 0
+
     # time-range pruning skips chunks whose bounds cannot intersect
+    sh.col_cache.clear()
+    sh.col_cache_bytes = 0
     loads["n"] = 0
     got = list(ev.find(app_id, start_time=base + dt.timedelta(days=2)))
     assert len(got) == 200 and loads["n"] == 1
+
+
+def test_find_target_ids_fast_path_matches_generic(tmp_path):
+    """The serving fast path (no Event materialization) must agree with
+    find() on every filter combination, including tombstones and the
+    unflushed WAL tail."""
+    s, app_id = make_storage(tmp_path, "eventlog")
+    ev = s.get_events()
+    evs = [Event(event="view" if j % 3 else "buy", entity_type="user",
+                 entity_id=f"u{j % 7}", target_entity_type="item",
+                 target_entity_id=f"i{j % 11}",
+                 event_time=dt.datetime(2022, 1, 1, tzinfo=UTC)
+                 + dt.timedelta(seconds=j))
+           for j in range(400)]
+    ev.insert_batch(evs[:350], app_id)
+    ev.flush(app_id)
+    ev.insert_batch(evs[350:], app_id)          # unflushed tail
+    # tombstone one matching event
+    victim = next(e for e in ev.find(app_id, entity_id="u3",
+                                     event_names=["view"]))
+    ev.delete(victim.event_id, app_id)
+
+    for kwargs in (
+        dict(entity_type="user", entity_id="u3", event_names=["view"],
+             target_entity_type="item"),
+        dict(entity_type="user", entity_id="u5"),
+        dict(event_names=["buy"]),
+        dict(entity_type="user", entity_id="nope"),
+    ):
+        want = sorted(e.target_entity_id for e in ev.find(app_id, **kwargs)
+                      if e.target_entity_id is not None)
+        got = sorted(ev.find_target_ids(app_id, **kwargs))
+        assert got == want, kwargs
+
+    # store facade: fast path on eventlog, fallback parity on memory
+    from predictionio_tpu.data import store as store_mod
+    fast = sorted(store_mod.find_target_ids(
+        "app", entity_type="user", entity_id="u3", event_names=["view"],
+        target_entity_type="item", storage=s))
+    generic = sorted(e.target_entity_id for e in store_mod.find_by_entity(
+        "app", "user", "u3", event_names=["view"],
+        target_entity_type="item", storage=s))
+    assert fast == generic

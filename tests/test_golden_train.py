@@ -1,0 +1,145 @@
+"""End-to-end golden TRAIN parity: full multi-iteration ALS vs a dense
+numpy reference (round-3 verdict weak #5 / ask #7).
+
+The half-step goldens in test_golden_parity.py pin one solve; these pin
+the whole training LOOP — seeding, iteration wiring, regularization
+scaling, and checkpoint/resume segmentation — on a ~20x10 problem small
+enough to hand-solve densely. Single-device, 8-virtual-device mesh, and a
+resume-mid-train variant must all land on the same factors.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.parallel import als_dist
+from predictionio_tpu.parallel.mesh import get_mesh
+from predictionio_tpu.workflow.checkpoint import FactorCheckpointer
+
+N_U, N_I, RANK, LAM, ITERS, ALPHA = 20, 10, 3, 0.07, 5, 1.3
+_EPS = als._EPS
+
+
+def make_problem(seed=13, density=0.55):
+    rng = np.random.default_rng(seed)
+    mask = rng.random((N_U, N_I)) < density
+    # ensure no empty row/col so count-scaled reg never zeroes out
+    mask[np.arange(N_U), rng.integers(0, N_I, N_U)] = True
+    mask[rng.integers(0, N_U, N_I), np.arange(N_I)] = True
+    ui, ii = np.nonzero(mask)
+    vals = rng.uniform(0.5, 5.0, ui.shape[0]).astype(np.float32)
+    return ui.astype(np.int32), ii.astype(np.int32), vals
+
+
+def seed_factors():
+    U0, V0 = als._seed_factors(21, N_U, N_I, RANK)
+    return np.asarray(U0), np.asarray(V0)
+
+
+def dense_explicit(ui, ii, vals, U, V, iterations):
+    """Straight-line numpy ALS: per-row ridge solves, count-scaled reg."""
+    U, V = U.copy(), V.copy()
+    for _ in range(iterations):
+        for u in range(N_U):
+            sel = ui == u
+            Vu = V[ii[sel]]
+            A = Vu.T @ Vu + (LAM * sel.sum() + _EPS) * np.eye(RANK)
+            U[u] = np.linalg.solve(A, Vu.T @ vals[sel])
+        for i in range(N_I):
+            sel = ii == i
+            Uu = U[ui[sel]]
+            A = Uu.T @ Uu + (LAM * sel.sum() + _EPS) * np.eye(RANK)
+            V[i] = np.linalg.solve(A, Uu.T @ vals[sel])
+    return U, V
+
+
+def dense_implicit(ui, ii, vals, U, V, iterations):
+    """Hu-Koren-Volinsky in numpy: A = YtY + Yt(C-I)Y, b = Yt C p."""
+    U, V = U.copy(), V.copy()
+    for _ in range(iterations):
+        YtY = V.T @ V
+        for u in range(N_U):
+            sel = ui == u
+            Vu = V[ii[sel]]
+            conf = ALPHA * np.abs(vals[sel])
+            pref = (vals[sel] > 0).astype(np.float64)
+            A = YtY + Vu.T @ (conf[:, None] * Vu) \
+                + (LAM * sel.sum() + _EPS) * np.eye(RANK)
+            U[u] = np.linalg.solve(A, Vu.T @ ((1.0 + conf) * pref))
+        XtX = U.T @ U
+        for i in range(N_I):
+            sel = ii == i
+            Uu = U[ui[sel]]
+            conf = ALPHA * np.abs(vals[sel])
+            pref = (vals[sel] > 0).astype(np.float64)
+            A = XtX + Uu.T @ (conf[:, None] * Uu) \
+                + (LAM * sel.sum() + _EPS) * np.eye(RANK)
+            V[i] = np.linalg.solve(A, Uu.T @ ((1.0 + conf) * pref))
+    return U, V
+
+
+@pytest.fixture(scope="module")
+def problem():
+    ui, ii, vals = make_problem()
+    data = als.prepare_ratings(ui, ii, vals, N_U, N_I, chunk=32)
+    return ui, ii, vals, data
+
+
+def test_explicit_full_train_matches_dense(problem):
+    ui, ii, vals, data = problem
+    U0, V0 = seed_factors()
+    want_U, want_V = dense_explicit(ui, ii, vals, U0, V0, ITERS)
+    U, V = als.train_explicit(data, rank=RANK, iterations=ITERS,
+                              lambda_=LAM, u0=U0, v0=V0, chunk=32)
+    np.testing.assert_allclose(np.asarray(U), want_U, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(V), want_V, rtol=2e-3, atol=2e-4)
+
+
+def test_implicit_full_train_matches_dense(problem):
+    ui, ii, vals, data = problem
+    U0, V0 = seed_factors()
+    want_U, want_V = dense_implicit(ui, ii, vals, U0, V0, ITERS)
+    U, V = als.train_implicit(data, rank=RANK, iterations=ITERS,
+                              lambda_=LAM, alpha=ALPHA, u0=U0, v0=V0,
+                              chunk=32)
+    np.testing.assert_allclose(np.asarray(U), want_U, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(V), want_V, rtol=2e-3, atol=2e-4)
+
+
+def test_sharded_full_train_matches_dense(problem):
+    ui, ii, vals, data = problem
+    U0, V0 = seed_factors()
+    want_U, want_V = dense_explicit(ui, ii, vals, U0, V0, ITERS)
+    mesh = get_mesh(8)
+    U, V = als_dist.train_explicit_sharded(
+        mesh, data, rank=RANK, iterations=ITERS, lambda_=LAM,
+        u0=U0, v0=V0, chunk=32)
+    np.testing.assert_allclose(np.asarray(U), want_U, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(V), want_V, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("path", ["single", "sharded"])
+def test_resume_mid_train_matches_uninterrupted(problem, tmp_path, path):
+    """Crash after 3 of 5 iterations (snapshot at 2), resume to 5: the
+    result must equal the uninterrupted 5-iteration dense reference."""
+    ui, ii, vals, data = problem
+    U0, V0 = seed_factors()
+    want_U, want_V = dense_explicit(ui, ii, vals, U0, V0, ITERS)
+
+    def train(iterations, ckpt):
+        if path == "single":
+            return als.train_explicit(
+                data, rank=RANK, iterations=iterations, lambda_=LAM,
+                u0=U0, v0=V0, chunk=32, checkpoint_every=2,
+                checkpointer=ckpt)
+        return als_dist.train_explicit_sharded(
+            get_mesh(8), data, rank=RANK, iterations=iterations,
+            lambda_=LAM, u0=U0, v0=V0, chunk=32, checkpoint_every=2,
+            checkpointer=ckpt)
+
+    ckpt = FactorCheckpointer(str(tmp_path / "ck"))
+    train(3, ckpt)                      # "crashed" partial run; saved step 2
+    assert ckpt.latest()[0] == 2
+    U, V = train(ITERS, ckpt)           # restores step 2, runs 3 more
+    np.testing.assert_allclose(np.asarray(U), want_U, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(V), want_V, rtol=2e-3, atol=2e-4)
