@@ -125,7 +125,8 @@ class ECommAlgorithm(Algorithm):
         V_hat = V / np.maximum(
             np.linalg.norm(V, axis=1, keepdims=True), 1e-12)
         return ECommModel(
-            rank=self.ap.rank, user_features=U, product_features=V,
+            rank=self.ap.rank, user_features=np.asarray(U),
+            product_features=V,
             user_vocab=user_vocab, item_vocab=item_vocab, items=items,
             user_trained=user_trained, item_trained=item_trained,
             category_masks=build_category_masks(items, len(item_vocab)),
@@ -190,7 +191,7 @@ class ECommAlgorithm(Algorithm):
 
         user_ix = model.user_vocab.get(query.user)
         if user_ix is not None and model.user_trained[user_ix]:
-            query_vec = jnp.asarray(model.user_features[user_ix])
+            query_vec = np.asarray(model.user_features)[user_ix]
             factors = model.product_features
         else:
             logger.info("No userFeature found for user %s.", query.user)
@@ -208,13 +209,17 @@ class ECommAlgorithm(Algorithm):
         if not mask.any():
             return PredictedResult(())
         k = min(query.num, mask.shape[0])
-        vals, idx = topk.topk_scores(
-            query_vec, jnp.asarray(factors), mask=jnp.asarray(mask), k=k)
-        vals, idx = np.asarray(vals), np.asarray(idx)
+        # host serving: the factor matrices are host numpy after train, and
+        # one BLAS matvec + argpartition beats a per-query device dispatch
+        # everywhere except a locally-attached chip with a huge catalog
+        # (measured 273 ms p50 through a tunneled device vs <1 ms host)
+        scores = np.asarray(factors) @ np.asarray(query_vec)
+        scores = np.where(np.asarray(mask), scores, -np.inf)
+        vals, idx = topk.host_topk(scores, k)
         inv = model.item_vocab.inverse()
         return PredictedResult(tuple(
             ItemScore(item=inv(int(ix)), score=float(s))
-            for s, ix in zip(vals, idx) if s > 0))
+            for s, ix in zip(vals, idx) if s > 0 and np.isfinite(s)))
 
     def _recent_views_vector(self, model: ECommModel,
                              user: str) -> Optional[jnp.ndarray]:
@@ -236,5 +241,5 @@ class ECommAlgorithm(Algorithm):
         recent_ixs = {ix for ix in recent_ixs if model.item_trained[ix]}
         if not recent_ixs:
             return None
-        V_hat = jnp.asarray(model.product_features_hat)
-        return jnp.sum(V_hat[jnp.asarray(sorted(recent_ixs))], axis=0)
+        V_hat = np.asarray(model.product_features_hat)
+        return np.sum(V_hat[sorted(recent_ixs)], axis=0)

@@ -131,29 +131,29 @@ class ALSAlgorithm(Algorithm):
         topk.topk_for_user) wins on a locally-attached TPU; when the chip
         is remote/tunneled or the model is tiny, per-dispatch latency
         dominates and host BLAS + argpartition is faster. Probe a real
-        query at deploy time and keep whichever layout serves faster
-        (threshold PIO_SERVE_DEVICE_MS, default 3 ms). No reference
-        analogue — MLlib serving is always JVM-host-side."""
+        query at deploy time — whether the factors arrive as device
+        arrays (fresh train) or host numpy (loaded blob) — and keep
+        whichever layout serves faster (threshold PIO_SERVE_DEVICE_MS,
+        default 3 ms). No reference analogue — MLlib serving is always
+        JVM-host-side."""
         import os
         import time
 
         import jax
 
-        if isinstance(model.user_factors, np.ndarray):
-            return model  # already host-side
         try:
+            U = jax.device_put(np.asarray(model.user_factors))
+            V = jax.device_put(np.asarray(model.item_factors))
             k = min(10, len(model.item_vocab))
             ix = np.int32(0)
             # warm the compile, then time the steady state
-            jax.block_until_ready(topk.topk_for_user(
-                model.user_factors, model.item_factors, ix, k=k))
+            jax.device_get(topk.topk_for_user(U, V, ix, k=k))
             t0 = time.perf_counter()
             for _ in range(3):
-                jax.device_get(topk.topk_for_user(
-                    model.user_factors, model.item_factors, ix, k=k))
+                jax.device_get(topk.topk_for_user(U, V, ix, k=k))
             per_query_ms = (time.perf_counter() - t0) / 3 * 1e3
         except Exception:
-            return model
+            per_query_ms = float("inf")
         threshold = float(os.environ.get("PIO_SERVE_DEVICE_MS", "3.0"))
         if per_query_ms > threshold:
             import logging
@@ -165,7 +165,9 @@ class ALSAlgorithm(Algorithm):
                 user_factors=np.asarray(model.user_factors),
                 item_factors=np.asarray(model.item_factors),
                 user_vocab=model.user_vocab, item_vocab=model.item_vocab)
-        return model
+        return ALSModel(
+            rank=model.rank, user_factors=U, item_factors=V,
+            user_vocab=model.user_vocab, item_vocab=model.item_vocab)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         user_ix = model.user_vocab.get(query.user)

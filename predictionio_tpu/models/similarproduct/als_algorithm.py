@@ -30,21 +30,22 @@ logger = logging.getLogger("predictionio_tpu.similarproduct")
 
 def topk_to_result(model, query_vec, mask: "np.ndarray",
                    num: int) -> PredictedResult:
-    """Masked device top-K -> PredictedResult, dropping scores <= 0
-    (the reference keeps only positive scores, ALSAlgorithm.scala:167)."""
+    """Masked host top-K -> PredictedResult, dropping scores <= 0
+    (the reference keeps only positive scores, ALSAlgorithm.scala:167).
+    Host numpy serving: the factors live in host RAM after training, and
+    one BLAS matvec + argpartition beats per-query device dispatch on
+    remote/tunneled chips by orders of magnitude (273 ms -> <1 ms p50
+    measured on the bench's tunnel)."""
     if not mask.any():
         return PredictedResult(())
-    # k depends only on num (recompile per distinct num, not per mask);
-    # surplus slots come back as NEG_INF and fall to the s > 0 filter
     k = min(num, mask.shape[0])
-    vals, idx = topk.topk_scores(
-        jnp.asarray(query_vec), jnp.asarray(model.product_features),
-        mask=jnp.asarray(mask), k=k)
-    vals, idx = np.asarray(vals), np.asarray(idx)
+    scores = np.asarray(model.product_features) @ np.asarray(query_vec)
+    scores = np.where(np.asarray(mask), scores, -np.inf)
+    vals, idx = topk.host_topk(scores, k)
     inv = model.item_vocab.inverse()
     return PredictedResult(tuple(
         ItemScore(item=inv(int(ix)), score=float(s))
-        for s, ix in zip(vals, idx) if s > 0))
+        for s, ix in zip(vals, idx) if s > 0 and np.isfinite(s)))
 
 
 @dataclass(frozen=True)
@@ -190,8 +191,8 @@ class ALSAlgorithm(Algorithm):
                         query.items)
             return PredictedResult(())
 
-        V_hat = jnp.asarray(model.product_features)
-        q = jnp.sum(V_hat[jnp.asarray(sorted(query_ixs))], axis=0)
+        V_hat = np.asarray(model.product_features)
+        q = np.sum(V_hat[sorted(query_ixs)], axis=0)
         mask = candidate_mask(
             n_items=len(model.item_vocab),
             trained=model.trained_mask,

@@ -168,10 +168,15 @@ def prepare_ratings(
 # Device kernels
 # ---------------------------------------------------------------------------
 #
-# Two interchangeable Gram accumulators (A/B-testable via the trainers'
+# Three interchangeable Gram accumulators (A/B-testable via the trainers'
 # kernel= param / PIO_ALS_KERNEL env var):
 #
-#   "csrb" (default) — row-aligned mini-block layout + wide-row gather.
+#   "hybrid" (default) — dense-hot head on the MXU + csrb tail; see the
+#       hybrid section below. Measured 88 ms/iter at ML-20M rank 10 on a
+#       v5e (vs 150 for csrb, 1351 for round-3 scan), identical RMSE.
+#       Falls back to csrb when the item set is too small to split.
+#
+#   "csrb" — row-aligned mini-block layout + wide-row gather.
 #       Each row's entries are padded to a multiple of b (=32) so every
 #       mini-block of b consecutive entries belongs to exactly ONE row.
 #       Per half-step the opposite factors are expanded ONCE into
@@ -193,9 +198,10 @@ def prepare_ratings(
 
 def _kernel_flag(kernel: Optional[str]) -> str:
     import os
-    k = kernel or os.environ.get("PIO_ALS_KERNEL", "csrb")
-    if k not in ("csrb", "scan"):
-        raise ValueError(f"unknown ALS kernel {k!r} (want 'csrb' or 'scan')")
+    k = kernel or os.environ.get("PIO_ALS_KERNEL", "hybrid")
+    if k not in ("csrb", "scan", "hybrid"):
+        raise ValueError(
+            f"unknown ALS kernel {k!r} (want 'csrb', 'hybrid' or 'scan')")
     return k
 
 
@@ -307,30 +313,222 @@ def gram_rhs_csrb(
     of ~nnz/b updates. See the kernel comparison note above gram_rhs.
     """
     r = other_factors.shape[1]
+    X = _expand_X(other_factors, r, jnp.float32)
+    AB = _gram_rhs_csrb_flat(X, other_idx, coeff_a, coeff_b, mb_seg,
+                             n_self, b, chunk)
+    return AB[:, :r * r].reshape(n_self, r, r), AB[:, r * r:]
+
+
+# ---------------------------------------------------------------------------
+# Hybrid dense-hot kernel ("hybrid"): Zipf head on the MXU, tail on csrb
+# ---------------------------------------------------------------------------
+#
+# Under the power-law item popularity of real ratings data, the top-K items
+# (K=4096 default) carry ~60-70% of all entries. Those entries' Gram
+# contributions don't need gathers at all: build ONE pair of dense
+# coefficient matrices  D = [D_a | D_b]  (n_users, 2K, bf16) once per
+# training run (column j < K: the Gram weight of user-row u vs hot item j;
+# column K+j: the RHS weight), and then EVERY iteration both half-steps
+# become one MXU matmul each over the SAME matrix:
+#     user side :  AB_hot = [D_a @ Xo_hot | D_b @ V_hot]   (n_users, r²+r)
+#     item side :  AB_hot = [D_aᵀ @ Uo    | D_bᵀ @ U    ]  (K, r²+r)
+# (the item side reads D transposed — no second matrix). Only the cold
+# ~30-40% of entries ride the csrb gather path, shrinking its HBM-random
+# traffic proportionally. bf16 is lossless for half-star ratings and
+# presence/confidence weights; accumulation is f32 on the MXU.
+
+_HOT_K = 4096  # hot-item count; PIO_ALS_HOT_K overrides
+_HYBRID_DTYPE = jnp.bfloat16  # dense-hot matmul dtype (tests may override)
+# Rows with fewer ratings than this stay entirely on the f32 gather
+# tail: a row with count < rank has a rank-deficient Gram whose ridge
+# (lambda*count) amplifies dense-path rounding by ~1/lambda — measured
+# 43% factor error on 1-rating users riding the bf16 dense path. Applied
+# to USERS (all their entries go cold) and to candidate hot ITEMS (an
+# unpopular "hot" item under flat popularity would hit the same wall).
+# PIO_ALS_DENSE_MIN_COUNT overrides (tests lower it to cover the path).
+_DENSE_MIN_COUNT = 64
+
+
+def _dense_min_count() -> int:
+    import os
+    return int(os.environ.get("PIO_ALS_DENSE_MIN_COUNT", _DENSE_MIN_COUNT))
+
+
+@dataclass
+class HybridData:
+    """One-time per-train layout for the hybrid kernel."""
+    D: jnp.ndarray            # (n_users, 2K) bf16 dense hot coefficients
+    hot_ids: jnp.ndarray      # (K,) int32 hot item rows
+    u_tail: tuple             # (oi, rat, pres, seg) csrb layout, cold by user
+    i_tail: tuple             # (oi, rat, pres, seg) csrb layout, cold by item
+    u_chunk: int
+    i_chunk: int
+    K: int
+
+
+@partial(jax.jit, static_argnames=("K",))
+def _hybrid_top_jit(counts_i, K: int):
+    top_counts, hot_ids = lax.top_k(counts_i, K)
+    return top_counts, hot_ids.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=(
+    "n_users", "n_items", "K", "implicit", "b", "n_mb_u", "n_mb_i",
+    "min_count"))
+def _hybrid_prep_jit(u, i, r, hot_ids, counts_u, counts_i,
+                     n_users: int, n_items: int, K: int,
+                     implicit: bool, alpha, b: int, n_mb_u: int, n_mb_i: int,
+                     min_count: int):
+    """From (possibly padded) raw COO to D + cold-tail csrb layouts.
+
+    Padding entries carry u == n_users; they sort last and scatter out of
+    bounds (dropped). counts_u/counts_i come from prepare_ratings (no
+    re-bincount). All passes are sorts/gathers plus two 20M-scalar
+    scatter-adds for D — one-time costs, amortized over every iteration."""
+    # an unpopular candidate "hot" item is as rank-deficient as a sparse
+    # user; both stay on the f32 tail (see _DENSE_MIN_COUNT)
+    item_ok = jnp.take(counts_i, hot_ids) >= min_count
+    hot_rank = jnp.full((n_items,), -1, jnp.int32).at[hot_ids].set(
+        jnp.where(item_ok, jnp.arange(K, dtype=jnp.int32), -1))
+    hr = jnp.take(hot_rank, jnp.clip(i, 0, n_items - 1))
+    valid = u < n_users
+    dense_ok = jnp.take(counts_u, jnp.clip(u, 0, n_users - 1)) \
+        >= min_count
+    hot = (hr >= 0) & valid & dense_ok
+    if implicit:
+        conf = alpha * jnp.abs(r)
+        av = conf
+        bv = (1.0 + conf) * (r > 0).astype(jnp.float32)
+    else:
+        av = jnp.ones_like(r)
+        bv = r
+    # D scatter: non-hot/padding entries target a dummy column (sliced off)
+    col_a = jnp.where(hot, hr, 2 * K)
+    col_b = jnp.where(hot, K + hr, 2 * K)
+    row = jnp.where(valid, u, n_users)   # OOB rows drop
+    D = jnp.zeros((n_users, 2 * K + 1), _HYBRID_DTYPE)
+    D = D.at[row, col_a].add(av.astype(_HYBRID_DTYPE), mode="drop")
+    D = D.at[row, col_b].add(bv.astype(_HYBRID_DTYPE), mode="drop")
+    D = D[:, : 2 * K]
+
+    # cold tail, user orientation: cold entries first, sorted by user
+    sort_key = jnp.where(valid, hot.astype(jnp.int32), 2)
+    ks, uu, ii, rr = lax.sort((sort_key, u, i, r), num_keys=2)
+    cold_n_u = jnp.where(ks == 0, uu, n_users)   # ks: the SORTED key
+    counts_u_cold = jnp.bincount(cold_n_u, length=n_users + 1
+                                 )[:n_users].astype(jnp.int32)
+    u_tail = csrb_layout(ii, rr, counts_u_cold, n_users, b, n_mb_u)
+
+    # cold tail, item orientation
+    ks2, ii2, uu2, rr2 = lax.sort((sort_key, i, u, r), num_keys=2)
+    cold_n_i = jnp.where(ks2 == 0, ii2, n_items)
+    counts_i_cold = jnp.bincount(cold_n_i, length=n_items + 1
+                                 )[:n_items].astype(jnp.int32)
+    i_tail = csrb_layout(uu2, rr2, counts_i_cold, n_items, b, n_mb_i)
+    return D, u_tail, i_tail
+
+
+def _hybrid_prepare(data: ALSData, K: int, implicit: bool, alpha: float,
+                    b: int, chunk: int) -> HybridData:
+    bu, bi = data.by_user, data.by_item
+    u, i, r = bu.self_idx, bu.other_idx, bu.rating
+    n_users, n_items = data.n_users, data.n_items
+    min_count = _dense_min_count()
+    counts_i = jnp.asarray(bi.counts).astype(jnp.int32)
+    top_counts, hot_ids = _hybrid_top_jit(counts_i, K)
+    # one small host sync: tail-size bound -> tight static tail shapes
+    # (cold entries + every entry of below-threshold users, which stay on
+    # the f32 tail for conditioning)
+    counts_u_h = np.asarray(bu.counts)
+    sparse_extra = int(counts_u_h[counts_u_h < min_count].sum())
+    n_cold = max(
+        int(data.nnz - np.sum(np.asarray(top_counts))) + sparse_extra, 1)
+    n_mb_u, u_chunk = _csrb_plan(n_cold, n_users, b, chunk)
+    n_mb_i, i_chunk = _csrb_plan(n_cold, n_items, b, chunk)
+    D, u_tail, i_tail = _hybrid_prep_jit(
+        jnp.asarray(u), jnp.asarray(i), jnp.asarray(r), hot_ids,
+        jnp.asarray(bu.counts).astype(jnp.int32), counts_i,
+        n_users, n_items, K, implicit, jnp.float32(alpha), b,
+        n_mb_u, n_mb_i, min_count)
+    return HybridData(D=D, hot_ids=hot_ids, u_tail=u_tail, i_tail=i_tail,
+                      u_chunk=u_chunk, i_chunk=i_chunk, K=K)
+
+
+def _gram_col_mask(r: int):
+    # select gram columns from the a-product and rhs columns from the
+    # b-product via mask-add: concatenating offset SLICES miscompiles on
+    # the axon backend (measured wrong values on a plain input array), so
+    # only row slices + elementwise ops are used here
+    return jnp.concatenate([jnp.ones((r * r,), jnp.float32),
+                            jnp.zeros((r,), jnp.float32)])
+
+
+def _dense_hot_user(D, X_hot, K: int, r: int):
+    """[D_a @ X_hot(gram cols) | D_b @ X_hot(rhs cols)] via mask-add."""
+    g = jax.lax.dot_general(
+        D[:, :K], X_hot, (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    h = jax.lax.dot_general(
+        D[:, K:], X_hot, (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    m = _gram_col_mask(r)
+    return g * m + h * (1.0 - m)
+
+
+def _dense_hot_item(D, Z, K: int, r: int):
+    """[D_aᵀ @ Z(gram cols) | D_bᵀ @ Z(rhs cols)] -> (K, r²+r)."""
+    out = jax.lax.dot_general(
+        D, Z, (((0,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)      # (2K, r²+r)
+    m = _gram_col_mask(r)
+    return out[:K] * m + out[K:] * (1.0 - m)
+
+
+def _expand_X(factors, r: int, dtype):
+    return jnp.concatenate(
+        [(factors[:, :, None] * factors[:, None, :]).reshape(-1, r * r),
+         factors], axis=1).astype(dtype)
+
+
+def _gram_tail(other_factors_X, tail, n_self, b, chunk, implicit, alpha):
+    oi, rat, pres, seg = tail
+    if implicit:
+        conf = alpha * jnp.abs(rat)
+        ca, cb = conf, (1.0 + conf) * (rat > 0).astype(jnp.float32)
+    else:
+        ca, cb = pres, rat
+    return _gram_rhs_csrb_flat(other_factors_X, oi, ca, cb, seg,
+                               n_self, b, chunk)
+
+
+def _gram_rhs_csrb_flat(X, other_idx, coeff_a, coeff_b, mb_seg,
+                        n_self: int, b: int, chunk: int) -> jnp.ndarray:
+    """gram_rhs_csrb but taking a prebuilt X and returning flat (n, r²+r)
+    so hybrid can sum dense + tail before splitting into A and rhs."""
+    w = X.shape[1]
     n_mb = mb_seg.shape[0]
     m = max(chunk // b, 1)
     n_chunks = max(n_mb // m, 1)
-    w = r * r + r
-    X = jnp.concatenate(
-        [(other_factors[:, :, None] * other_factors[:, None, :]
-          ).reshape(-1, r * r), other_factors], axis=1)
-    mask_a = jnp.concatenate([jnp.ones((r * r,), jnp.float32),
-                              jnp.zeros((r,), jnp.float32)])
+    r2 = w - int((np.sqrt(4 * w + 1) - 1) / 2)  # w = r² + r
+    mask_a = jnp.concatenate([jnp.ones((r2,), jnp.float32),
+                              jnp.zeros((w - r2,), jnp.float32)])
 
     def body(_, xs):
         o, ca, cb = xs
-        rows = jnp.take(X, o, axis=0)                       # (E, w)
+        rows = jnp.take(X, o, axis=0).astype(jnp.float32)
         s = ca[:, None] * mask_a[None, :] + cb[:, None] * (1 - mask_a)[None, :]
-        M = jnp.sum((rows * s).reshape(m, b, w), axis=1)    # (m, w)
+        M = jnp.sum((rows * s).reshape(m, b, w), axis=1)
         return 0, M
 
     _, Ms = lax.scan(body, 0, (other_idx.reshape(n_chunks, m * b),
                                coeff_a.reshape(n_chunks, m * b),
                                coeff_b.reshape(n_chunks, m * b)))
-    AB = jax.ops.segment_sum(Ms.reshape(n_mb, w), mb_seg,
-                             num_segments=n_self + 1,
-                             indices_are_sorted=True)[:-1]
-    return AB[:, :r * r].reshape(n_self, r, r), AB[:, r * r:]
+    return jax.ops.segment_sum(Ms.reshape(n_mb, w), mb_seg,
+                               num_segments=n_self + 1,
+                               indices_are_sorted=True)[:-1]
 
 
 def solve_factors(A: jnp.ndarray, b: jnp.ndarray, reg: jnp.ndarray) -> jnp.ndarray:
@@ -492,6 +690,76 @@ def _run_csrb(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
                           checkpointer)
 
 
+@partial(jax.jit, static_argnames=(
+    "n_users", "n_items", "K", "b", "u_chunk", "i_chunk", "reg_scaling",
+    "implicit"))
+def _train_hybrid_jit(
+    D, hot_ids, u_oi, u_rat, u_pres, u_seg, i_oi, i_rat, i_pres, i_seg,
+    u_counts, i_counts, U0, V0, iterations, lambda_: float, alpha: float,
+    n_users: int, n_items: int, K: int, b: int, u_chunk: int, i_chunk: int,
+    reg_scaling: str, implicit: bool,
+):
+    r = U0.shape[1]
+    u_reg = _reg_vec(u_counts, n_users, lambda_, reg_scaling)
+    i_reg = _reg_vec(i_counts, n_items, lambda_, reg_scaling)
+
+    def one_iter(_, UV):
+        U, V = UV
+        # ---- user half-step: dense hot items + csrb cold tail
+        X = _expand_X(V, r, jnp.float32)                 # (n_items, r²+r)
+        X_hot = jnp.take(X, hot_ids, axis=0).astype(_HYBRID_DTYPE)
+        AB = _dense_hot_user(D, X_hot, K, r)
+        AB = AB + _gram_tail(X, (u_oi, u_rat, u_pres, u_seg),
+                             n_users, b, u_chunk, implicit, alpha)
+        A = AB[:, : r * r].reshape(n_users, r, r)
+        if implicit:
+            A = A + (V.T @ V)[None]
+        U = solve_factors(A, AB[:, r * r:], u_reg)
+        # ---- item half-step: same D transposed + csrb cold tail
+        Z = _expand_X(U, r, jnp.float32)                 # (n_users, r²+r)
+        AB_hot = _dense_hot_item(D, Z.astype(_HYBRID_DTYPE), K, r)
+        ABi = _gram_tail(Z, (i_oi, i_rat, i_pres, i_seg),
+                         n_items, b, i_chunk, implicit, alpha)
+        ABi = ABi.at[hot_ids].add(AB_hot)
+        Ai = ABi[:, : r * r].reshape(n_items, r, r)
+        if implicit:
+            Ai = Ai + (U.T @ U)[None]
+        V = solve_factors(Ai, ABi[:, r * r:], i_reg)
+        return (U, V)
+
+    return lax.fori_loop(0, iterations, one_iter, (U0, V0))
+
+
+def _run_hybrid(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
+                reg_scaling, implicit, u0, v0, checkpoint_every,
+                checkpointer):
+    """Hybrid-kernel driver; falls back to csrb when the item set is too
+    small for a meaningful hot/cold split."""
+    import os
+    K = int(os.environ.get("PIO_ALS_HOT_K", _HOT_K))
+    if data.n_items < 2 * K or data.n_users < 2:
+        return _run_csrb(data, rank, iterations, lambda_, alpha, seed, chunk,
+                         reg_scaling, implicit, u0, v0, checkpoint_every,
+                         checkpointer)
+    b = _CSRB_B
+    hy = _hybrid_prepare(data, K, implicit, float(alpha), b, chunk)
+    if u0 is None or v0 is None:
+        u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
+    bu, bi = data.by_user, data.by_item
+
+    def run(u, v, n_iters):
+        return _train_hybrid_jit(
+            hy.D, hy.hot_ids, *hy.u_tail, *hy.i_tail,
+            bu.counts, bi.counts, u, v, iterations=n_iters,
+            lambda_=float(lambda_), alpha=float(alpha),
+            n_users=data.n_users, n_items=data.n_items, K=hy.K, b=b,
+            u_chunk=hy.u_chunk, i_chunk=hy.i_chunk,
+            reg_scaling=reg_scaling, implicit=implicit)
+
+    return _run_segmented(run, u0, v0, iterations, checkpoint_every,
+                          checkpointer)
+
+
 def init_factors(key, n: int, rank: int) -> jnp.ndarray:
     """MLlib-style init: abs(normal)/sqrt(rank) keeps first solves well-scaled."""
     return jnp.abs(jax.random.normal(key, (n, rank), dtype=jnp.float32)) / jnp.sqrt(
@@ -589,10 +857,16 @@ def train_explicit(
     save(step, {...}) / latest() -> (step, {...}) | None), training runs
     in compiled segments and snapshots factors between them — the
     iteration-level resume the reference lacks (SURVEY.md §5
-    checkpoint/resume). kernel selects the Gram accumulator ("csrb"
-    default, "scan" legacy; PIO_ALS_KERNEL overrides).
+    checkpoint/resume). kernel selects the Gram accumulator ("hybrid"
+    default — dense-hot MXU head + f32 gather tail; "csrb" pure-gather;
+    "scan" legacy; PIO_ALS_KERNEL overrides).
     """
-    if _kernel_flag(kernel) == "csrb":
+    k = _kernel_flag(kernel)
+    if k == "hybrid":
+        return _run_hybrid(data, rank, iterations, lambda_, 0.0, seed, chunk,
+                           reg_scaling, False, u0, v0, checkpoint_every,
+                           checkpointer)
+    if k == "csrb":
         return _run_csrb(data, rank, iterations, lambda_, 0.0, seed, chunk,
                          reg_scaling, False, u0, v0, checkpoint_every,
                          checkpointer)
@@ -680,7 +954,12 @@ def train_implicit(
     padding rows have weight 0 so they contribute nothing. Checkpoint
     semantics match train_explicit; kernel as in train_explicit.
     """
-    if _kernel_flag(kernel) == "csrb":
+    k = _kernel_flag(kernel)
+    if k == "hybrid":
+        return _run_hybrid(data, rank, iterations, lambda_, alpha, seed,
+                           chunk, reg_scaling, True, u0, v0,
+                           checkpoint_every, checkpointer)
+    if k == "csrb":
         return _run_csrb(data, rank, iterations, lambda_, alpha, seed, chunk,
                          reg_scaling, True, u0, v0, checkpoint_every,
                          checkpointer)

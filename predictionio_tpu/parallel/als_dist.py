@@ -203,7 +203,8 @@ def _train_sharded(
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
     su, si = prepare_sharded(data, n_dev, chunk)
-    csrb = _kernel_flag(kernel) == "csrb"
+    # per-device hybrid is not implemented; hybrid maps to csrb here
+    csrb = _kernel_flag(kernel) in ("csrb", "hybrid")
     b = _CSRB_B
     # per-device csrb plans (static: nnz_dev is the max-padded per-device
     # entry count, rows_dev the per-device row-slot count)

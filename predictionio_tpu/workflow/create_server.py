@@ -108,7 +108,12 @@ def prepare_deploy(ctx, engine: Engine, engine_params: EngineParams,
                    algorithms: Optional[List[Any]] = None) -> List[Any]:
     """Make persisted models servable (Engine.prepareDeploy,
     Engine.scala:199-269): manifest -> user loader; None -> retrain;
-    otherwise device_put the blob's arrays back into HBM."""
+    otherwise hand the host-side blob to the algorithm. Device placement
+    is each algorithm's prepare_serving decision (the recommendation
+    template probes the deployed chip and moves factors into HBM only
+    when the fused device dispatch actually wins) — a blanket
+    device_put here made every host-numpy serving path pull the full
+    factor matrix back over the link per query."""
     if algorithms is None:
         _, _, algorithms, _ = engine._instantiate(engine_params)
     out = []
@@ -125,7 +130,7 @@ def prepare_deploy(ctx, engine: Engine, engine_params: EngineParams,
                 retrained = engine.train(ctx, engine_params)
             out.append(retrained[i])
         else:
-            out.append(model_io.device_put_tree(model))
+            out.append(model)
     return out
 
 

@@ -199,3 +199,51 @@ def test_csrb_layout_roundtrip():
     # padding slots carry zero weight and a nondecreasing segment map
     assert np.all(np.diff(seg) >= 0)
     assert np.all(rat[pres == 0] == 0.0)
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_hybrid_kernel_matches_csrb(implicit, monkeypatch):
+    """The hybrid (dense-hot + csrb-tail) kernel uses bf16 for the hot
+    matmuls, so parity is at model level: ~1% Frobenius on factors and
+    equivalent reconstruction RMSE vs the f32 csrb kernel. The threshold
+    is lowered so the bf16 dense path is ACTUALLY exercised (avg user
+    count here is ~24; the default 64 would zero out D entirely)."""
+    monkeypatch.setenv("PIO_ALS_HOT_K", "64")
+    monkeypatch.setenv("PIO_ALS_DENSE_MIN_COUNT", "8")
+    rng = np.random.default_rng(3)
+    n_u, n_i, nnz = 500, 300, 12000
+    item_w = 1.0 / np.arange(1, n_i + 1) ** 0.8
+    ii = np.searchsorted(np.cumsum(item_w / item_w.sum()),
+                         rng.random(nnz)).astype(np.int32)
+    np.clip(ii, 0, n_i - 1, out=ii)
+    ui = rng.integers(0, n_u, nnz).astype(np.int32)
+    vals = np.clip(np.round(rng.uniform(0.5, 5.0, nnz) * 2) / 2,
+                   0.5, 5.0).astype(np.float32)
+    data = als.prepare_ratings(ui, ii, vals, n_u, n_i, chunk=1024)
+    train = als.train_implicit if implicit else als.train_explicit
+    U1, V1 = train(data, rank=6, iterations=4, lambda_=0.05, seed=7,
+                   chunk=1024, kernel="csrb")
+    U2, V2 = train(data, rank=6, iterations=4, lambda_=0.05, seed=7,
+                   chunk=1024, kernel="hybrid")
+    U1, V1, U2, V2 = map(np.asarray, (U1, V1, U2, V2))
+    assert np.linalg.norm(U1 - U2) / np.linalg.norm(U1) < 0.02
+    assert np.linalg.norm(V1 - V2) / np.linalg.norm(V1) < 0.02
+    if not implicit:
+        p1 = (U1 @ V1.T)[ui, ii]
+        p2 = (U2 @ V2.T)[ui, ii]
+        r1 = float(np.sqrt(np.mean((p1 - vals) ** 2)))
+        r2 = float(np.sqrt(np.mean((p2 - vals) ** 2)))
+        assert abs(r1 - r2) < 0.01 * max(r1, 1e-6)
+
+
+def test_hybrid_small_item_set_falls_back(monkeypatch):
+    """n_items < 2K: hybrid silently uses the csrb path (bit-identical)."""
+    monkeypatch.setenv("PIO_ALS_HOT_K", "4096")
+    ui, ii, vals = make_problem(n_u=40, n_i=25, rank=4, density=0.4, seed=7)
+    data = als.prepare_ratings(ui, ii, vals, 40, 25, chunk=64)
+    U1, V1 = als.train_explicit(data, rank=4, iterations=3, lambda_=0.05,
+                                seed=11, chunk=64, kernel="csrb")
+    U2, V2 = als.train_explicit(data, rank=4, iterations=3, lambda_=0.05,
+                                seed=11, chunk=64, kernel="hybrid")
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
+    np.testing.assert_array_equal(np.asarray(V1), np.asarray(V2))
