@@ -213,9 +213,7 @@ class ECommAlgorithm(Algorithm):
         # one BLAS matvec + argpartition beats a per-query device dispatch
         # everywhere except a locally-attached chip with a huge catalog
         # (measured 273 ms p50 through a tunneled device vs <1 ms host)
-        scores = np.asarray(factors) @ np.asarray(query_vec)
-        scores = np.where(np.asarray(mask), scores, -np.inf)
-        vals, idx = topk.host_topk(scores, k)
+        vals, idx = topk.host_masked_topk(factors, query_vec, mask, k)
         inv = model.item_vocab.inverse()
         return PredictedResult(tuple(
             ItemScore(item=inv(int(ix)), score=float(s))

@@ -14,7 +14,6 @@ import logging
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.controller import Algorithm, Params
@@ -39,9 +38,8 @@ def topk_to_result(model, query_vec, mask: "np.ndarray",
     if not mask.any():
         return PredictedResult(())
     k = min(num, mask.shape[0])
-    scores = np.asarray(model.product_features) @ np.asarray(query_vec)
-    scores = np.where(np.asarray(mask), scores, -np.inf)
-    vals, idx = topk.host_topk(scores, k)
+    vals, idx = topk.host_masked_topk(model.product_features, query_vec,
+                                      mask, k)
     inv = model.item_vocab.inverse()
     return PredictedResult(tuple(
         ItemScore(item=inv(int(ix)), score=float(s))
@@ -94,7 +92,7 @@ def candidate_mask(n_items: int,
                    exclude: set) -> np.ndarray:
     """isCandidateItem as one boolean vector (ALSAlgorithm.scala:233+).
 
-    Inputs may be device arrays after a deploy round-trip (device_put_tree
+    Inputs are host numpy after train/load (deploy no longer device_puts
     pushes every numeric leaf); the mask is host-side scratch, so coerce.
     """
     mask = np.array(trained, dtype=bool)

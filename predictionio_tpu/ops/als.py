@@ -441,8 +441,13 @@ def _hybrid_prepare(data: ALSData, K: int, implicit: bool, alpha: float,
     # the f32 tail for conditioning)
     counts_u_h = np.asarray(bu.counts)
     sparse_extra = int(counts_u_h[counts_u_h < min_count].sum())
-    n_cold = max(
-        int(data.nnz - np.sum(np.asarray(top_counts))) + sparse_extra, 1)
+    top_h = np.asarray(top_counts)
+    # only top-K items that PASS the min-count floor actually leave the
+    # tail; a below-floor "hot" candidate's entries stay cold and must be
+    # budgeted (overlap with sparse-user entries double-counts — fine for
+    # an upper bound; underestimating would silently DROP ratings)
+    dense_served = int(top_h[top_h >= min_count].sum())
+    n_cold = max(int(data.nnz) - dense_served + sparse_extra, 1)
     n_mb_u, u_chunk = _csrb_plan(n_cold, n_users, b, chunk)
     n_mb_i, i_chunk = _csrb_plan(n_cold, n_items, b, chunk)
     D, u_tail, i_tail = _hybrid_prep_jit(

@@ -48,6 +48,17 @@ def topk_for_user(
     return jax.lax.top_k(item_factors @ q, k)
 
 
+def host_masked_topk(factors, query_vec, mask, k: int):
+    """Host serving kernel shared by the item-scoring templates: one BLAS
+    matvec, -inf outside the candidate mask, argpartition top-K. Callers
+    drop non-finite/non-positive entries when building results."""
+    import numpy as np
+
+    scores = np.asarray(factors) @ np.asarray(query_vec)
+    scores = np.where(np.asarray(mask), scores, -np.inf)
+    return host_topk(scores, k)
+
+
 def host_topk(scores, k: int):
     """numpy argpartition top-K for host-side serving (small models or
     remote devices where per-query dispatch latency dominates)."""

@@ -165,11 +165,18 @@ def run_evaluation(
             evaluator.output_path = output_path
         result = evaluator.evaluate_base(ctx, evaluation, engine_eval_data_sets)
         row = instances.get(instance_id)
-        instances.update(EvaluationInstance(
-            **{**row.__dict__, "status": "EVALCOMPLETED", "end_time": _now(),
-               "evaluator_results": str(result),
-               "evaluator_results_html": result.to_html(),
-               "evaluator_results_json": result.to_json()}))
+        if getattr(result, "no_save", False):
+            # FakeEvalResult.noSave parity: ledger row only, no results
+            instances.update(EvaluationInstance(
+                **{**row.__dict__, "status": "EVALCOMPLETED",
+                   "end_time": _now()}))
+        else:
+            instances.update(EvaluationInstance(
+                **{**row.__dict__, "status": "EVALCOMPLETED",
+                   "end_time": _now(),
+                   "evaluator_results": str(result),
+                   "evaluator_results_html": result.to_html(),
+                   "evaluator_results_json": result.to_json()}))
         logger.info("EvaluationInstance %s EVALCOMPLETED", instance_id)
         return result
     except Exception:

@@ -3,8 +3,9 @@
 Reference role: the Kryo blob path (CoreWorkflow.scala:76-81 serialize;
 CreateServer.scala:195-199 deserialize). Here the container is pickle with
 every jax.Array converted to numpy on save and restored host-side on load;
-`device_put_tree` pushes a loaded model's arrays back into HBM at deploy
-(the "factor matrices straight into HBM" path of BASELINE.json).
+`device_put_tree` can push a model's arrays into HBM for algorithms whose
+prepare_serving probes the device path as faster (deploy itself hands
+models to algorithms host-side; per-query host serving is the default).
 
 Models are arbitrary user objects (dataclasses, dicts, tuples, BiMaps...),
 not registered pytrees, so the walker is structural rather than

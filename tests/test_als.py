@@ -247,3 +247,24 @@ def test_hybrid_small_item_set_falls_back(monkeypatch):
                                 seed=11, chunk=64, kernel="hybrid")
     np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
     np.testing.assert_array_equal(np.asarray(V1), np.asarray(V2))
+
+
+def test_hybrid_below_floor_hot_items_stay_on_tail(monkeypatch):
+    """Tail-budget regression (review r4): candidate hot items whose count
+    is below the dense floor must be BUDGETED into the tail, not silently
+    dropped. Flat popularity + dense-eligible users exercises it."""
+    monkeypatch.setenv("PIO_ALS_HOT_K", "8")
+    rng = np.random.default_rng(1)
+    n_u, n_i = 5, 20
+    ui = np.repeat(np.arange(n_u, dtype=np.int32), 100)      # 100 each >= 64
+    ii = rng.integers(0, n_i, 500).astype(np.int32)          # ~25/item < 64
+    vals = rng.uniform(0.5, 5.0, 500).astype(np.float32)
+    data = als.prepare_ratings(ui, ii, vals, n_u, n_i, chunk=64)
+    U1, V1 = als.train_explicit(data, rank=3, iterations=3, lambda_=0.05,
+                                seed=5, chunk=64, kernel="csrb")
+    U2, V2 = als.train_explicit(data, rank=3, iterations=3, lambda_=0.05,
+                                seed=5, chunk=64, kernel="hybrid")
+    np.testing.assert_allclose(np.asarray(U1), np.asarray(U2),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(V1), np.asarray(V2),
+                               rtol=1e-4, atol=1e-5)

@@ -158,3 +158,25 @@ def test_fast_eval_memoization(memory_storage, rated_app):
     # re-evaluating an already-seen variant is fully cached
     wf.eval(grid()[0])
     assert wf.counts["train"] == 4 and wf.counts["serve"] == 4
+
+
+def test_fake_run_executes_under_workflow(memory_storage, tmp_path):
+    """FakeWorkflow parity (FakeWorkflow.scala:28-109): a FakeRun's func
+    executes with the real WorkflowContext via run_evaluation, and its
+    noSave result leaves only the ledger row."""
+    from predictionio_tpu.workflow.fake import FakeRun
+
+    seen = {}
+
+    class Hello(FakeRun):
+        def func(self, ctx):
+            seen["storage"] = ctx.storage
+
+    fr = Hello()
+    ctx = WorkflowContext(storage=memory_storage)
+    result = run_evaluation(ctx, fr, fr.engine_params_list,
+                            evaluation_class="Hello")
+    assert seen["storage"] is memory_storage
+    assert str(result) == "FakeEvalResult()"
+    rows = memory_storage.get_meta_data_evaluation_instances().get_completed()
+    assert len(rows) == 1 and rows[0].evaluator_results == ""

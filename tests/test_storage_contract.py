@@ -358,3 +358,44 @@ def test_remote_columnar_and_binary_models(tmp_path):
 
     finally:
         server.shutdown()
+
+
+def test_remote_find_pages_through_timestamp_ties(tmp_path):
+    """Forward cursor paging skips already-seen rows at the boundary
+    timestamp via offset — including the pathological case of one
+    timestamp carrying more rows than a whole page."""
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_B_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path / "b.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "B",
+    })
+    from predictionio_tpu.data.storage.remote import serve_storage
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    try:
+        remote = Storage(env={
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+        })
+        ev = remote.get_events()
+        ev.init(9)
+        # 12 events at ONE timestamp + 7 spread out, paged 5 at a time
+        evs = [Event(event="e", entity_type="u", entity_id=f"tie{k}",
+                     event_time=t(10)) for k in range(12)]
+        evs += [Event(event="e", entity_type="u", entity_id=f"later{k}",
+                      event_time=t(20 + k)) for k in range(7)]
+        ev.insert_batch(evs, 9)
+        ev.PAGE = 5
+        got = [e.entity_id for e in ev.find(app_id=9)]
+        assert len(got) == 19
+        assert sorted(got) == sorted(
+            [f"tie{k}" for k in range(12)] + [f"later{k}" for k in range(7)])
+        # no duplicates across page boundaries
+        assert len(set(got)) == 19
+    finally:
+        server.shutdown()
