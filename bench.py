@@ -175,7 +175,17 @@ def measure_eval_grid(storage, n_events: int = 100_000, n_users: int = 943,
     from predictionio_tpu.workflow.context import WorkflowContext
 
     app_id = storage.get_meta_data_apps().insert(App(0, "BenchEval"))
-    u, i, r = synth_codes(n_users, n_items, n_events, seed=100)
+    # latent low-rank structure (not iid noise) so Precision@10 measures
+    # something: a learnable signal exists and the grid's better variants
+    # visibly beat the random baseline
+    rng = np.random.default_rng(100)
+    Ut = rng.normal(0, 1, (n_users, 6))
+    Vt = rng.normal(0, 1, (n_items, 6))
+    u, i, _ = synth_codes(n_users, n_items, n_events, seed=100)
+    scores = np.einsum("ij,ij->i", Ut[u], Vt[i]) / np.sqrt(6)
+    scores += rng.normal(0, 0.5, n_events)
+    r = np.clip(np.round((3.0 + 1.2 * scores) * 2) / 2, 0.5, 5.0
+                ).astype(np.float32)
     seed_event_store(storage, app_id, u, i, r, n_users)
 
     params = engine_params_list("BenchEval", k_fold=5, query_num=10)
@@ -479,6 +489,9 @@ def main() -> None:
                 "event_store_write_s": round(write_s, 3),
                 "http_ingest_events_per_s": (round(http_eps)
                                              if http_eps else None),
+                # remote-compile through the device tunnel; the local
+                # persistent cache does not apply, so this is paid per
+                # process and is NOT part of any steady-state claim
                 "warmup_compile_s": round(warm_s, 3),
                 "checksums": [round(ck_a1, 2), round(ck_a2, 2),
                               round(ck_b1, 2), round(ck_b2, 2)],
