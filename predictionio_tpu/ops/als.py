@@ -11,13 +11,14 @@ Design (TPU-first, not a port of MLlib's block-to-block shuffle):
   all shapes are static.
 - One half-iteration solves, for every user u (symmetrically items):
       (sum_i c_ui v_i v_i^T + reg_u I) x_u = sum_i b_ui v_i
-  The Gram matrices are accumulated with **chunked gather + flattened
-  outer products + one sorted segment_sum** under `lax.scan`: outer
-  products live as 2D (chunk, r*r [+ r]) rows (lane-aligned; a (chunk,
-  r, r) tensor would tile each r x r matrix to (8, 128) — a measured
-  4.7x slowdown) and the (n, r*r+r) accumulator stays in HBM.
-- The per-row solves are **batched dense solves** over (n, r, r) — millions
-  of tiny SPD systems, exactly what vectorized XLA linalg is good at.
+  The Gram matrices are accumulated by one of three kernels (see the
+  "Device kernels" section): the default **hybrid** puts the Zipf head
+  on the MXU as dense bf16 matmuls and the tail on the **csrb**
+  mini-block wide-row-gather path; "scan" is the legacy per-entry
+  sorted segment-sum.
+- The per-row solves are **batched unrolled Gauss-Jordan sweeps** over
+  (n, r, r) — millions of tiny SPD systems as r fully-parallel
+  elementwise passes (batched LAPACK LU serializes badly on TPU).
 - Regularization follows MLlib's ALS-WR scaling: lambda * n_ratings(u)
   (reg_scaling="count"), with "constant" available.
 - The whole `iterations`-loop compiles as one XLA program via
