@@ -1,0 +1,171 @@
+"""Batch views: EventSeq/LBatchView folds + DataView columnar snapshot cache
+(ref: data/.../view/{LBatchView,DataView}.scala)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import store, view
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+
+
+@pytest.fixture()
+def app(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "viewapp", None))
+    memory_storage.get_events().init(app_id)
+    return app_id
+
+
+def t(minute):
+    return dt.datetime(2021, 1, 1, 0, minute, tzinfo=dt.timezone.utc)
+
+
+def ev(name, eid, props=None, minute=0, etype="user", **kw):
+    return Event(event=name, entity_type=etype, entity_id=eid,
+                 properties=DataMap(props or {}), event_time=t(minute), **kw)
+
+
+def seed(app_id):
+    store.write([
+        ev("$set", "u1", {"plan": "free"}, minute=0),
+        ev("$set", "u1", {"plan": "pro", "age": 30}, minute=2),
+        ev("$unset", "u2", {"plan": None}, minute=3),
+        ev("$set", "u2", {"plan": "free"}, minute=1),
+        ev("$delete", "u3", minute=5),
+        ev("$set", "u3", {"plan": "pro"}, minute=4),
+        ev("buy", "u1", {"price": 9.5}, minute=6,
+           target_entity_type="item", target_entity_id="i1"),
+        ev("buy", "u2", {"price": 3.0}, minute=7,
+           target_entity_type="item", target_entity_id="i2"),
+        ev("$set", "cart1", {"open": True}, minute=8, etype="cart"),
+    ], app_id)
+
+
+class TestEventSeq:
+    def test_filter_semantics(self, memory_storage, app):
+        seed(app)
+        lbv = view.LBatchView(app, storage=memory_storage)
+        seq = lbv.events
+        assert len(seq) == 9
+        # event filter
+        assert {e.entity_id for e in seq.filter(event="buy")} == {"u1", "u2"}
+        # start_time strictly-after, until_time strictly-before
+        # (ViewPredicates semantics, LBatchView.scala:39-52)
+        win = seq.filter(start_time=t(6), until_time=t(8))
+        assert [e.entity_id for e in win] == ["u2"]
+        # entity_type
+        assert [e.entity_id for e in seq.filter(entity_type="cart")] == \
+            ["cart1"]
+        # custom predicate composes
+        pricy = seq.filter(event="buy",
+                           predicate=lambda e: e.properties.get("price") > 5)
+        assert [e.entity_id for e in pricy] == ["u1"]
+
+    def test_aggregate_by_entity_ordered(self, memory_storage, app):
+        seed(app)
+        seq = view.LBatchView(app, storage=memory_storage).events.filter(
+            event="buy")
+        total = seq.aggregate_by_entity_ordered(
+            0.0, lambda acc, e: acc + e.properties.get("price"))
+        assert total == {"u1": 9.5, "u2": 3.0}
+
+    def test_fold_respects_event_time_not_insert_order(
+            self, memory_storage, app):
+        seed(app)  # u2's $set (minute 1) was written AFTER its $unset (min 3)
+        lbv = view.LBatchView(app, storage=memory_storage)
+        props = lbv.aggregate_properties("user")
+        assert props["u1"].get("plan") == "pro" and props["u1"].get("age") == 30
+        assert not props["u2"].contains("plan")     # unset won (later time)
+        assert "u3" not in props                    # $delete (minute 5) last
+        assert "cart1" not in props                 # wrong entityType
+
+    def test_window_scopes_view(self, memory_storage, app):
+        seed(app)
+        lbv = view.LBatchView(app, until_time=t(2), storage=memory_storage)
+        props = lbv.aggregate_properties("user")
+        assert props["u1"].get("plan") == "free"    # pro $set at minute 2 cut
+
+
+class TestDataViewCreate:
+    @staticmethod
+    def conv(e):
+        if e.event != "buy":
+            return None
+        return {"user": e.entity_id, "item": e.target_entity_id,
+                "price": float(e.properties.get("price"))}
+
+    def test_columnar_snapshot(self, memory_storage, app, tmp_path):
+        seed(app)
+        cols = view.create("viewapp", self.conv, name="buys",
+                           base_dir=str(tmp_path), storage=memory_storage)
+        assert sorted(cols) == ["item", "price", "user"]
+        assert cols["price"].dtype == np.float64
+        assert list(cols["user"]) == ["u1", "u2"]
+        np.testing.assert_allclose(cols["price"], [9.5, 3.0])
+
+    def test_cache_hit_skips_store(self, memory_storage, app, tmp_path):
+        seed(app)
+        win = dict(start_time=t(0), until_time=t(30))
+        first = view.create("viewapp", self.conv, name="buys",
+                            base_dir=str(tmp_path), storage=memory_storage,
+                            **win)
+        assert len(first["user"]) == 2
+        # new event inside the window; same key => cached copy returned
+        store.write([ev("buy", "u9", {"price": 1.0}, minute=9,
+                        target_entity_type="item", target_entity_id="i9")],
+                    app)
+        again = view.create("viewapp", self.conv, name="buys",
+                            base_dir=str(tmp_path), storage=memory_storage,
+                            **win)
+        assert list(again["user"]) == ["u1", "u2"]
+        # bumping version invalidates (DataView.scala:53-54 contract)
+        fresh = view.create("viewapp", self.conv, name="buys", version="v2",
+                            base_dir=str(tmp_path), storage=memory_storage,
+                            **win)
+        assert list(fresh["user"]) == ["u1", "u2", "u9"]
+
+    def test_channel_gets_own_cache_key(self, memory_storage, app, tmp_path):
+        from predictionio_tpu.data.storage import Channel
+        seed(app)
+        cid = memory_storage.get_meta_data_channels().insert(
+            Channel(0, "mobile", app))
+        memory_storage.get_events().init(app, cid)
+        store.write([ev("buy", "m1", {"price": 2.0}, minute=1,
+                        target_entity_type="item", target_entity_id="i1")],
+                    app, cid)
+        win = dict(start_time=t(0), until_time=t(30))
+        default = view.create("viewapp", self.conv, name="buys",
+                              base_dir=str(tmp_path),
+                              storage=memory_storage, **win)
+        mobile = view.create("viewapp", self.conv, name="buys",
+                             channel_name="mobile", base_dir=str(tmp_path),
+                             storage=memory_storage, **win)
+        assert list(default["user"]) == ["u1", "u2"]
+        assert list(mobile["user"]) == ["m1"]   # not the default's cache
+
+    def test_non_scalar_column_rejected_before_cache_write(
+            self, memory_storage, app, tmp_path):
+        seed(app)
+        def bad(e):
+            if e.event != "buy":
+                return None
+            return {"user": e.entity_id, "tags": ["a", "b"]}
+        with pytest.raises(ValueError, match="non-scalar"):
+            view.create("viewapp", bad, name="tags",
+                        base_dir=str(tmp_path), storage=memory_storage)
+        assert not list(tmp_path.glob("*.npz"))   # nothing poisoned
+
+    def test_inconsistent_rows_rejected(self, memory_storage, app, tmp_path):
+        seed(app)
+        def bad(e):
+            if e.event != "buy":
+                return None
+            return {"user": e.entity_id} if e.entity_id == "u1" else \
+                {"other": 1}
+        with pytest.raises(ValueError, match="inconsistent"):
+            view.create("viewapp", bad, name="bad",
+                        base_dir=str(tmp_path), storage=memory_storage)
