@@ -95,3 +95,49 @@ class BiMap(Generic[K, V]):
             if k not in fwd:
                 fwd[k] = float(len(fwd))
         return BiMap(fwd)
+
+
+class EntityMap(Generic[V]):
+    """Typed entities + their id↔index BiMap (EntityMap.scala:69-99).
+
+    `id_to_data` maps entityId → extracted object; `id_to_ix` assigns each
+    id a dense index (first-appearance order) so entity attributes can be
+    gathered into device arrays positionally: build an array where row
+    `id_to_ix(eid)` holds eid's features and the index IS the embedding row.
+    """
+
+    def __init__(self, id_to_data: Dict[str, V],
+                 id_to_ix: "BiMap[str, int]" = None):
+        self.id_to_data = dict(id_to_data)
+        self.id_to_ix: BiMap[str, int] = (
+            id_to_ix if id_to_ix is not None
+            else BiMap.string_int(self.id_to_data.keys()))
+
+    def data(self, id_or_ix) -> V:
+        if isinstance(id_or_ix, str):
+            return self.id_to_data[id_or_ix]
+        return self.id_to_data[self.id_to_ix.inverse()(int(id_or_ix))]
+
+    def get_data(self, id_or_ix, default=None):
+        try:
+            return self.data(id_or_ix)
+        except KeyError:
+            return default
+
+    def contains(self, entity_id: str) -> bool:
+        return entity_id in self.id_to_data
+
+    def __len__(self) -> int:
+        return len(self.id_to_data)
+
+    def __iter__(self):
+        return iter(self.id_to_data)
+
+    def take(self, n: int) -> "EntityMap[V]":
+        new_ix = self.id_to_ix.take(n)
+        return EntityMap(
+            {k: v for k, v in self.id_to_data.items() if new_ix.contains(k)},
+            new_ix)
+
+    def __repr__(self) -> str:
+        return f"EntityMap({len(self)} entities)"

@@ -154,6 +154,40 @@ def aggregate_properties(
     )
 
 
+def extract_entity_map(
+    app_name: str,
+    entity_type: str,
+    extract,
+    channel_name: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    required: Optional[Sequence[str]] = None,
+    storage: Optional[Storage] = None,
+) -> "EntityMap":
+    """Aggregate an entityType's properties and extract typed objects
+    (PEvents.extractEntityMap, PEvents.scala:134-165).
+
+    `extract(property_map) -> A` runs per entity; extraction errors name the
+    failing entity. The EntityMap's dense id→ix assignment is the row order
+    for positional feature arrays on device.
+    """
+    from predictionio_tpu.data.bimap import EntityMap
+
+    props = aggregate_properties(
+        app_name, entity_type, channel_name=channel_name,
+        start_time=start_time, until_time=until_time, required=required,
+        storage=storage)
+    id_to_data = {}
+    for eid, dm in props.items():
+        try:
+            id_to_data[eid] = extract(dm)
+        except Exception as e:
+            raise StoreError(
+                f"Failed to extract entity from DataMap of entityId "
+                f"{eid!r}: {e}") from e
+    return EntityMap(id_to_data)
+
+
 # ---------------------------------------------------------------------------
 # Columnar TPU ingestion
 # ---------------------------------------------------------------------------

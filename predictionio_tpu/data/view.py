@@ -149,6 +149,7 @@ def _columnar(rows: Sequence[Mapping[str, Any]]) -> Dict[str, np.ndarray]:
     if not rows:
         return {}
     cols: Dict[str, list] = {k: [] for k in rows[0].keys()}
+    is_str: Dict[str, bool] = {}
     for row in rows:
         if row.keys() != cols.keys():
             raise ValueError(
@@ -160,11 +161,23 @@ def _columnar(rows: Sequence[Mapping[str, Any]]) -> Dict[str, np.ndarray]:
                     f"conversion function returned non-scalar column "
                     f"{k!r}={v!r} ({type(v).__name__}); columns must be "
                     f"str/int/float/bool")
+            # a mixed str/number column would be silently *string-coerced*
+            # by np.asarray (not object dtype) — reject it explicitly
+            if is_str.setdefault(k, isinstance(v, str)) != isinstance(v, str):
+                raise ValueError(
+                    f"column {k!r} mixes strings and numbers "
+                    f"(got {v!r} after a "
+                    f"{'string' if is_str[k] else 'numeric'} value)")
             cols[k].append(v)
     out = {k: np.asarray(v) for k, v in cols.items()}
+    # backstop for anything that still coerced to object dtype (e.g. a
+    # Python int beyond int64) — an object column would pickle on save
+    # but fail every allow_pickle=False load
     bad = [k for k, a in out.items() if a.dtype == object]
-    if bad:  # e.g. mixed str/int in one column
-        raise ValueError(f"columns {bad} have mixed types (object dtype)")
+    if bad:
+        raise ValueError(
+            f"columns {bad} did not coerce to a numeric/string dtype "
+            f"(e.g. out-of-int64-range integers)")
     return out
 
 
@@ -200,6 +213,11 @@ def create(
     and `version` — bump `version` when the conversion function changes,
     exactly the reference's contract. A cache hit never touches the event
     store.
+
+    CACHING REQUIRES AN EXPLICIT `until_time`: with the default None the
+    window's end is fixed at "now" (reference behavior, DataView.scala:78-81),
+    which lands in the cache key — every call gets a fresh key, re-reads the
+    store, and writes a snapshot nothing will ever read back.
     """
     from predictionio_tpu.data import store as _store
 

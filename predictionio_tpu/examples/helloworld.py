@@ -1,0 +1,91 @@
+"""HelloWorld: per-day average temperature.
+
+Parity: examples/experimental/scala-local-helloworld/HelloWorld.scala (and
+the java-local / java-parallel variants — one Python runtime here). A CSV of
+``day,temperature`` lines trains a day → mean-temperature model; querying a
+day returns its average. The fold is a jax segment-mean so even the toy
+engine exercises the device path end-to-end.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (DataSource, FirstServing,
+                                         IdentityPreparator, Params,
+                                         SimpleEngine)
+from predictionio_tpu.controller.base import Algorithm
+
+
+@dataclass(frozen=True)
+class HelloWorldDataSourceParams(Params):
+    filepath: str
+
+
+@dataclass
+class HelloWorldTrainingData:
+    temperatures: List[Tuple[str, float]]     # (day, temperature)
+
+
+@dataclass(frozen=True)
+class HelloQuery:
+    day: str
+
+
+@dataclass
+class HelloPrediction:
+    temperature: float
+
+
+class HelloWorldDataSource(DataSource):
+    params_class = HelloWorldDataSourceParams
+
+    def __init__(self, params: HelloWorldDataSourceParams):
+        self.dsp = params
+
+    def read_training(self, ctx) -> HelloWorldTrainingData:
+        rows: List[Tuple[str, float]] = []
+        with open(self.dsp.filepath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                day, temp = line.split(",")
+                rows.append((day, float(temp)))
+        return HelloWorldTrainingData(rows)
+
+
+class HelloWorldAlgorithm(Algorithm):
+    """Day-keyed mean via segment_sum (HelloWorld.scala:MyAlgorithm)."""
+
+    def train(self, ctx, pd: HelloWorldTrainingData) -> Dict[str, float]:
+        import jax.numpy as jnp
+        from jax.ops import segment_sum
+
+        days = sorted({d for d, _ in pd.temperatures})
+        code = {d: i for i, d in enumerate(days)}
+        seg = jnp.asarray([code[d] for d, _ in pd.temperatures])
+        temps = jnp.asarray([t for _, t in pd.temperatures],
+                            dtype=jnp.float32)
+        totals = segment_sum(temps, seg, num_segments=len(days))
+        counts = segment_sum(jnp.ones_like(temps), seg,
+                             num_segments=len(days))
+        means = np.asarray(totals / counts)
+        return {d: float(means[i]) for d, i in code.items()}
+
+    def predict(self, model: Dict[str, float],
+                query: HelloQuery) -> HelloPrediction:
+        return HelloPrediction(temperature=model[query.day])
+
+    @property
+    def query_class(self):
+        return HelloQuery
+
+
+def engine() -> SimpleEngine:
+    """MyEngineFactory (HelloWorld.scala)."""
+    return SimpleEngine(HelloWorldDataSource, IdentityPreparator,
+                        HelloWorldAlgorithm, FirstServing)

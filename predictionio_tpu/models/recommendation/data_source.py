@@ -69,6 +69,26 @@ class TrainingData(SanityCheck):
                 f"({self.n and list(zip(self.user_idx[:2], self.item_idx[:2], self.rating[:2]))}...)")
 
 
+def training_data_from_columnar(col) -> TrainingData:
+    """Columnar rate/buy events → TrainingData: buy maps to BUY_RATING
+    regardless of properties (DataSource.scala:57-59), a rate event with no
+    numeric rating is an error (:62-68). Shared by this template and the
+    example variants (entitymap / sliding-eval datasources)."""
+    rating = col.rating.copy()
+    if "buy" in col.event_names:
+        buy_code = col.event_names.index("buy")
+        rating[col.event_name_idx == buy_code] = BUY_RATING
+    if np.isnan(rating).any():
+        bad = int(np.isnan(rating).sum())
+        raise ValueError(
+            f"{bad} rate event(s) have no numeric 'rating' property — "
+            "cannot convert to Rating (DataSource.scala:62-68 behavior)")
+    return TrainingData(
+        user_idx=col.entity_idx, item_idx=col.target_idx, rating=rating,
+        user_vocab=col.entity_ids, item_vocab=col.target_ids,
+    )
+
+
 class DataSource(BaseDataSource):
     params_class = DataSourceParams
 
@@ -87,20 +107,7 @@ class DataSource(BaseDataSource):
             target_vocab=target_vocab,
             storage=ctx.storage,
         )
-        rating = col.rating.copy()
-        # buy -> 4.0 regardless of properties (DataSource.scala:57-59)
-        if "buy" in col.event_names:
-            buy_code = col.event_names.index("buy")
-            rating[col.event_name_idx == buy_code] = BUY_RATING
-        if np.isnan(rating).any():
-            bad = int(np.isnan(rating).sum())
-            raise ValueError(
-                f"{bad} rate event(s) have no numeric 'rating' property — "
-                "cannot convert to Rating (DataSource.scala:62-68 behavior)")
-        return TrainingData(
-            user_idx=col.entity_idx, item_idx=col.target_idx, rating=rating,
-            user_vocab=col.entity_ids, item_vocab=col.target_ids,
-        )
+        return training_data_from_columnar(col)
 
     def read_training(self, ctx) -> TrainingData:
         return self._get_ratings(ctx)

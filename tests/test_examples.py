@@ -1,0 +1,643 @@
+"""Experimental example engines (ref: examples/experimental/)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.workflow import WorkflowContext, run_evaluation
+
+
+class TestHelloWorld:
+    """scala-local-helloworld parity: day -> mean temperature."""
+
+    def test_train_and_predict(self, tmp_path):
+        from predictionio_tpu.examples import helloworld as hw
+        csv = tmp_path / "data.csv"
+        csv.write_text("Mon,75.5\nTue,80.5\nWed,69.5\nMon,76.5\n")
+        engine = hw.engine()
+        ep = EngineParams(
+            data_source_params=hw.HelloWorldDataSourceParams(str(csv)),
+            algorithm_params_list=(("", None),))
+        ctx = WorkflowContext()
+        models = engine.train(ctx, ep)
+        algo = hw.HelloWorldAlgorithm()
+        assert algo.predict(models[0], hw.HelloQuery("Mon")).temperature == \
+            pytest.approx(76.0)
+        assert algo.predict(models[0], hw.HelloQuery("Tue")).temperature == \
+            pytest.approx(80.5)
+
+
+class TestRegression:
+    """scala-parallel-regression parity: SGD linear fit + k-fold MSE."""
+
+    @staticmethod
+    def write_data(path, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(0, 1, (n, 3))
+        y = x @ np.array([2.0, -1.0, 0.5]) + 0.25 + rng.normal(0, 0.01, n)
+        np.savetxt(path, np.column_stack([y, x]), fmt="%.6f")
+
+    def test_sgd_recovers_weights(self, tmp_path):
+        from predictionio_tpu.examples import regression as rg
+        f = tmp_path / "lr_data.txt"
+        self.write_data(f)
+        engine = rg.engine()
+        ep = EngineParams(
+            data_source_params=rg.RegressionDataSourceParams(str(f)),
+            algorithm_params_list=(
+                ("SGD", rg.SGDAlgorithmParams(numIterations=400,
+                                              stepSize=0.5)),))
+        models = engine.train(WorkflowContext(), ep)
+        w = models[0]
+        np.testing.assert_allclose(w, [2.0, -1.0, 0.5, 0.25], atol=0.05)
+        algo = rg.SGDRegressionAlgorithm(rg.SGDAlgorithmParams())
+        pred = algo.predict(w, np.array([1.0, 1.0, 1.0]))
+        assert pred == pytest.approx(2.0 - 1.0 + 0.5 + 0.25, abs=0.1)
+
+    def test_kfold_eval_grid(self, tmp_path, memory_storage):
+        """Three stepSize variants through the full eval pipeline
+        (Run.scala's Workflow.run with MeanSquareError)."""
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.examples import regression as rg
+        f = tmp_path / "lr_data.txt"
+        self.write_data(f, n=120)
+
+        class RegEval(Evaluation):
+            engine = rg.engine()
+            metric = rg.MeanSquareError()
+
+        grid = [EngineParams(
+            data_source_params=rg.RegressionDataSourceParams(str(f), k=3),
+            algorithm_params_list=(
+                ("SGD", rg.SGDAlgorithmParams(numIterations=300,
+                                              stepSize=s)),))
+            for s in (0.05, 0.2, 0.5)]
+        ctx = WorkflowContext(storage=memory_storage)
+        result = run_evaluation(ctx, RegEval(), grid,
+                                evaluation_class="RegEval")
+        assert len(result.engine_params_scores) == 3
+        # MSE: lower is better; best must be the minimum, near zero
+        scores = [s.score for s in result.engine_params_scores]
+        assert result.best_score.score == min(scores)
+        assert result.best_score.score < 0.05
+
+
+class TestRefactorTest:
+    """scala-refactor-test parity: vanilla engine through train + eval."""
+
+    def test_train(self):
+        from predictionio_tpu.examples import refactor_test as rt
+        engine = rt.engine()
+        ep = EngineParams(algorithm_params_list=(
+            ("algo", rt.VanillaAlgorithmParams(mult=2)),))
+        models = engine.train(WorkflowContext(), ep)
+        assert models[0] == sum(range(100)) * 2
+
+    def test_eval_three_sets(self, memory_storage):
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.examples import refactor_test as rt
+
+        class VanillaEval(Evaluation):
+            engine = rt.engine()
+            metric = rt.VanillaMetric()
+
+        ep = EngineParams(algorithm_params_list=(
+            ("algo", rt.VanillaAlgorithmParams(mult=1)),))
+        ctx = WorkflowContext(storage=memory_storage)
+        result = run_evaluation(ctx, VanillaEval(), [ep])
+        # mean over 3 sets x 20 queries of (4950 + q) = 4950 + 9.5
+        assert result.best_score.score == pytest.approx(4959.5)
+
+
+class TestFriendRecommendation:
+    """friend-recommendation parity: keyword dot, random baseline, SimRank."""
+
+    @pytest.fixture()
+    def files(self, tmp_path):
+        # item: "id cat kw;kw"  user: "id kw:w;kw:w"  action: "src dst a b c"
+        (tmp_path / "item.txt").write_text(
+            "10 1 1;2\n20 2 2;3\n")
+        (tmp_path / "user.txt").write_text(
+            "100 1:0.5;2:1.0\n200 3:2.0\n300 2:1.0\n")
+        (tmp_path / "action.txt").write_text(
+            "100 200 1 0 0\n200 300 0 1 0\n100 300 1 1 0\n")
+        return tmp_path
+
+    def params(self, d):
+        from predictionio_tpu.examples import friend_recommendation as fr
+        return fr.FriendRecommendationDataSourceParams(
+            itemFilePath=str(d / "item.txt"),
+            userKeywordFilePath=str(d / "user.txt"),
+            userActionFilePath=str(d / "action.txt"))
+
+    def test_keyword_similarity(self, files):
+        from predictionio_tpu.examples import friend_recommendation as fr
+        engine = fr.keyword_engine()
+        ep = EngineParams(data_source_params=self.params(files),
+                          algorithm_params_list=(("", None),))
+        models = engine.train(WorkflowContext(), ep)
+        algo = fr.KeywordSimilarityAlgorithm()
+        # user 100 {1:0.5, 2:1.0} . item 10 {1,2} = 1.5 -> accepted
+        p = algo.predict(models[0], fr.FriendRecommendationQuery(100, 10))
+        assert p.confidence == pytest.approx(1.5) and p.acceptance
+        # user 200 {3:2.0} . item 10 {1,2} = 0 -> rejected
+        p = algo.predict(models[0], fr.FriendRecommendationQuery(200, 10))
+        assert p.confidence == 0.0 and not p.acceptance
+        # unseen user -> confidence 0 (reference: empty map)
+        p = algo.predict(models[0], fr.FriendRecommendationQuery(999, 10))
+        assert p.confidence == 0.0
+
+    def test_simrank_against_dense_reference(self, files):
+        """Matrix SimRank must equal the textbook per-pair recurrence."""
+        from predictionio_tpu.examples import friend_recommendation as fr
+        engine = fr.simrank_engine()
+        ep = EngineParams(
+            data_source_params=self.params(files),
+            algorithm_params_list=(
+                ("", fr.SimRankAlgorithmParams(numIterations=4, decay=0.8)),))
+        models = engine.train(WorkflowContext(), ep)
+        model = models[0]
+        # dense numpy reference: s(a,b) = C/(|I(a)||I(b)|) sum s(in_a, in_b)
+        a = np.zeros((3, 3))
+        edges = [(0, 1), (1, 2), (0, 2)]     # internal ids by file order
+        for s, d in edges:
+            a[s, d] = 1.0
+        s_ref = np.eye(3)
+        for _ in range(4):
+            new = np.eye(3)
+            for x in range(3):
+                for y in range(3):
+                    if x == y:
+                        continue
+                    in_x, in_y = np.where(a[:, x])[0], np.where(a[:, y])[0]
+                    if len(in_x) == 0 or len(in_y) == 0:
+                        continue
+                    tot = sum(s_ref[i, j] for i in in_x for j in in_y)
+                    new[x, y] = 0.8 * tot / (len(in_x) * len(in_y))
+            s_ref = new
+        np.testing.assert_allclose(model.scores, s_ref, atol=1e-5)
+        # users 200,300 (internal 1,2) share in-neighbor 100 -> similar
+        p = fr.SimRankAlgorithm().predict(
+            model, fr.FriendRecommendationQuery(200, 300))
+        assert p.confidence > 0 and p.acceptance
+
+    def test_random_is_deterministic(self, files):
+        from predictionio_tpu.examples import friend_recommendation as fr
+        engine = fr.random_engine()
+        ep = EngineParams(data_source_params=self.params(files),
+                          algorithm_params_list=(("", None),))
+        models = engine.train(WorkflowContext(), ep)
+        algo = fr.RandomAlgorithm()
+        q = fr.FriendRecommendationQuery(100, 10)
+        assert algo.predict(models[0], q).confidence == \
+            algo.predict(models[0], q).confidence
+
+
+class TestDIMSUM:
+    """similarproduct-dimsum parity: exact cosine gram + filtered serving."""
+
+    @pytest.fixture()
+    def app(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(0, "dimsumapp", None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        evs = []
+        for u in ("u1", "u2", "u3"):
+            evs.append(Event(event="$set", entity_type="user", entity_id=u,
+                             event_time=t0))
+        for i, cats in (("i1", ("a",)), ("i2", ("a", "b")), ("i3", ("b",))):
+            evs.append(Event(event="$set", entity_type="item", entity_id=i,
+                             properties=DataMap({"categories": list(cats)}),
+                             event_time=t0))
+        views = [("u1", "i1"), ("u1", "i2"), ("u2", "i1"), ("u2", "i2"),
+                 ("u3", "i3"), ("u1", "i1")]      # dup view deduped
+        for n, (u, i) in enumerate(views):
+            evs.append(Event(
+                event="view", entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+                event_time=t0 + dt.timedelta(minutes=n)))
+        store.write(evs, app_id)
+        return app_id
+
+    def train(self, memory_storage, threshold=0.0):
+        from predictionio_tpu.examples import dimsum as dm
+        from predictionio_tpu.models.similarproduct.data_source import (
+            DataSourceParams)
+        engine = dm.engine()
+        ep = EngineParams(
+            data_source_params=DataSourceParams(appName="dimsumapp"),
+            algorithm_params_list=(
+                ("dimsum", dm.DIMSUMAlgorithmParams(threshold=threshold)),))
+        ctx = WorkflowContext(storage=memory_storage)
+        return dm, engine.train(ctx, ep)[0]
+
+    def test_cosine_matches_numpy(self, memory_storage, app):
+        dm, model = self.train(memory_storage)
+        # i1,i2 both viewed by exactly {u1,u2} -> cosine 1; i3 disjoint -> 0
+        v1 = model.item_vocab("i1")
+        v2 = model.item_vocab("i2")
+        v3 = model.item_vocab("i3")
+        assert model.similarities[v1, v2] == pytest.approx(1.0, abs=1e-6)
+        assert model.similarities[v1, v3] == 0.0
+        assert model.similarities[v1, v1] == 0.0      # diag zeroed
+
+    def test_serving_filters(self, memory_storage, app):
+        from predictionio_tpu.models.similarproduct.engine import Query
+        dm, model = self.train(memory_storage)
+        algo = dm.DIMSUMAlgorithm()
+        r = algo.predict(model, Query(items=("i1",), num=5))
+        assert [s.item for s in r.itemScores] == ["i2"]   # i3 has sim 0
+        # category filter: i2 is in b; restricting to b keeps it, to "z" kills
+        r = algo.predict(model, Query(items=("i1",), num=5,
+                                      categories=("b",)))
+        assert [s.item for s in r.itemScores] == ["i2"]
+        r = algo.predict(model, Query(items=("i1",), num=5,
+                                      categories=("z",)))
+        assert r.itemScores == ()
+        # blackList
+        r = algo.predict(model, Query(items=("i1",), num=5,
+                                      blackList=("i2",)))
+        assert r.itemScores == ()
+        # unseen query item -> empty
+        r = algo.predict(model, Query(items=("nope",), num=5))
+        assert r.itemScores == ()
+
+    def test_threshold_zeroes_small_sims(self, memory_storage, app):
+        dm, model = self.train(memory_storage, threshold=1.1)
+        assert not model.similarities.any()
+
+
+class TestRecommendationVariants:
+    """cat / entitymap / custom-datasource parity."""
+
+    @pytest.fixture()
+    def cat_app(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(0, "catapp", None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        evs = []
+        for u in ("u1", "u2", "u3"):
+            evs.append(Event(event="$set", entity_type="user", entity_id=u,
+                             event_time=t0))
+        for i, cats in (("i1", ["a"]), ("i2", ["b"]), ("i3", ["a", "b"])):
+            evs.append(Event(event="$set", entity_type="item", entity_id=i,
+                             properties=DataMap({"categories": cats}),
+                             event_time=t0))
+        # u1, u2 view i1+i3 heavily; u3 views i2
+        views = [("u1", "i1"), ("u1", "i1"), ("u1", "i3"), ("u2", "i1"),
+                 ("u2", "i3"), ("u2", "i3"), ("u3", "i2")]
+        for n, (u, i) in enumerate(views):
+            evs.append(Event(
+                event="view", entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+                event_time=t0 + dt.timedelta(minutes=n)))
+        from predictionio_tpu.data import store as st
+        st.write(evs, app_id)
+        return app_id
+
+    def test_category_als(self, memory_storage, cat_app):
+        from predictionio_tpu.examples import recommendation_variants as rv
+        from predictionio_tpu.models.similarproduct.data_source import (
+            DataSourceParams)
+        engine = rv.cat_engine()
+        ep = EngineParams(
+            data_source_params=DataSourceParams(appName="catapp"),
+            algorithm_params_list=(
+                ("als", rv.CategoryALSParams(rank=4, numIterations=8,
+                                             seed=7)),))
+        ctx = WorkflowContext(storage=memory_storage)
+        model = engine.train(ctx, ep)[0]
+        algo = rv.CategoryALSAlgorithm()
+        # u1's top pick should be a viewed-cluster item
+        r = algo.predict(model, rv.CatQuery(user="u1", num=2))
+        assert len(r.itemScores) == 2
+        # category filter "a" excludes i2
+        r = algo.predict(model, rv.CatQuery(user="u1", num=3,
+                                            categories=("a",)))
+        assert all(s.item in ("i1", "i3") for s in r.itemScores)
+        # blackList
+        r = algo.predict(model, rv.CatQuery(user="u1", num=3,
+                                            blackList=("i1", "i3")))
+        assert all(s.item == "i2" for s in r.itemScores)
+        # whiteList
+        r = algo.predict(model, rv.CatQuery(user="u1", num=3,
+                                            whiteList=("i1",)))
+        assert [s.item for s in r.itemScores] == ["i1"]
+        # unseen user -> empty
+        assert algo.predict(model,
+                            rv.CatQuery(user="zz", num=3)).itemScores == ()
+
+    @pytest.fixture()
+    def em_app(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(0, "emapp", None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        evs = []
+        for n, u in enumerate(("u1", "u2")):
+            evs.append(Event(
+                event="$set", entity_type="user", entity_id=u,
+                properties=DataMap({"attr0": 1.5 + n, "attr1": n,
+                                    "attr2": 10 + n}),
+                event_time=t0))
+        for n, i in enumerate(("i1", "i2")):
+            evs.append(Event(
+                event="$set", entity_type="item", entity_id=i,
+                properties=DataMap({"attrA": f"s{n}", "attrB": n,
+                                    "attrC": bool(n)}),
+                event_time=t0))
+        pairs = [("u1", "i1", "rate", 5.0), ("u1", "i2", "buy", None),
+                 ("u2", "i2", "rate", 3.0)]
+        for n, (u, i, e, r) in enumerate(pairs):
+            props = DataMap({"rating": r}) if r is not None else DataMap()
+            evs.append(Event(
+                event=e, entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+                properties=props,
+                event_time=t0 + dt.timedelta(minutes=n)))
+        store.write(evs, app_id)
+        return app_id
+
+    def test_entitymap_datasource(self, memory_storage, em_app):
+        from predictionio_tpu.examples import recommendation_variants as rv
+        ds = rv.EntityMapDataSource(rv.EntityMapDataSourceParams("emapp"))
+        ctx = WorkflowContext(storage=memory_storage)
+        td = ds.read_training(ctx)
+        assert td.n == 3
+        # buy -> 4.0 (reference DataSource.scala mapping)
+        buys = td.rating[np.isclose(td.rating, 4.0)]
+        assert buys.size == 1
+        # typed entity maps ride along
+        assert td.users.data("u1") == rv.User(attr0=1.5, attr1=0, attr2=10)
+        assert td.items.data("i2") == rv.EMItem(attrA="s1", attrB=1,
+                                                attrC=True)
+
+    def test_entitymap_full_train(self, memory_storage, em_app):
+        from predictionio_tpu.examples import recommendation_variants as rv
+        from predictionio_tpu.models.recommendation import ALSAlgorithmParams
+        engine = rv.entitymap_engine()
+        ep = EngineParams(
+            data_source_params=rv.EntityMapDataSourceParams("emapp"),
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(rank=2, numIterations=3,
+                                           lambda_=0.1, seed=1)),))
+        ctx = WorkflowContext(storage=memory_storage)
+        models = engine.train(ctx, ep)
+        assert models[0].user_factors.shape[1] == 2
+
+    def test_file_datasource(self, tmp_path, memory_storage):
+        from predictionio_tpu.examples import recommendation_variants as rv
+        from predictionio_tpu.models.recommendation import ALSAlgorithmParams
+        f = tmp_path / "ratings.txt"
+        f.write_text("u1::i1::5.0\nu1::i2::1.0\nu2::i1::4.0\nu2::i2::2.0\n")
+        engine = rv.file_engine()
+        ep = EngineParams(
+            data_source_params=rv.FileDataSourceParams(str(f)),
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(rank=2, numIterations=5,
+                                           lambda_=0.1, seed=3)),))
+        models = engine.train(WorkflowContext(storage=memory_storage), ep)
+        m = models[0]
+        # reconstruction must rank i1 above i2 for u1
+        u = m.user_vocab("u1")
+        s1 = m.item_factors[m.item_vocab("i1")] @ m.user_factors[u]
+        s2 = m.item_factors[m.item_vocab("i2")] @ m.user_factors[u]
+        assert float(s1) > float(s2)
+
+
+class TestMaintenanceApps:
+    """cleanup-app / trim-app parity."""
+
+    @staticmethod
+    def seed(memory_storage, name, n=6):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(App(0, name, None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        store.write([Event(
+            event="e", entity_type="user", entity_id=f"u{i}",
+            properties=DataMap({"i": i}),
+            event_time=t0 + dt.timedelta(days=i)) for i in range(n)], app_id)
+        return app_id, t0
+
+    def test_cleanup_deletes_before_cutoff(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.examples import apps
+        app_id, t0 = self.seed(memory_storage, "cleanapp")
+        engine = apps.cleanup_engine()
+        ep = EngineParams(
+            data_source_params=apps.CleanupDataSourceParams(
+                appId=app_id, cutoffTime=t0 + dt.timedelta(days=3)),
+            algorithm_params_list=(("", None),))
+        ctx = WorkflowContext(storage=memory_storage)
+        report = engine.train(ctx, ep)[0]
+        assert (report.count_before, report.affected, report.count_after) == \
+            (6, 3, 3)
+        remaining = list(memory_storage.get_events().find(app_id=app_id))
+        assert sorted(e.entity_id for e in remaining) == ["u3", "u4", "u5"]
+
+    def test_trim_copies_window_and_refuses_nonempty(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data.storage import App
+        from predictionio_tpu.examples import apps
+        src, t0 = self.seed(memory_storage, "srcapp")
+        dst = memory_storage.get_meta_data_apps().insert(App(0, "dstapp", None))
+        memory_storage.get_events().init(dst)
+        engine = apps.trim_engine()
+        ep = EngineParams(
+            data_source_params=apps.TrimDataSourceParams(
+                srcAppId=src, dstAppId=dst,
+                startTime=t0 + dt.timedelta(days=1),
+                untilTime=t0 + dt.timedelta(days=4)),
+            algorithm_params_list=(("", None),))
+        ctx = WorkflowContext(storage=memory_storage)
+        report = engine.train(ctx, ep)[0]
+        assert report.affected == 3
+        copied = list(memory_storage.get_events().find(app_id=dst))
+        assert sorted(e.entity_id for e in copied) == ["u1", "u2", "u3"]
+        # second run: dst non-empty -> refuse (reference throws)
+        with pytest.raises(RuntimeError, match="not empty"):
+            engine.train(ctx, ep)
+
+
+class TestMovieLens:
+    """movielens-filtering + movielens-evaluation parity."""
+
+    def test_temp_filter_serving(self, tmp_path):
+        from predictionio_tpu.examples import movielens as ml
+        from predictionio_tpu.models.recommendation.engine import (
+            ItemScore, PredictedResult, Query)
+        f = tmp_path / "disabled.txt"
+        f.write_text("i2\n")
+        serving = ml.TempFilterServing(ml.TempFilterParams(str(f)))
+        pred = PredictedResult(itemScores=(
+            ItemScore("i1", 3.0), ItemScore("i2", 2.5), ItemScore("i3", 1.0)))
+        out = serving.serve(Query(user="u", num=3), [pred])
+        assert [s.item for s in out.itemScores] == ["i1", "i3"]
+        # file re-read per request: enabling i2 back needs no redeploy
+        f.write_text("")
+        out = serving.serve(Query(user="u", num=3), [pred])
+        assert len(out.itemScores) == 3
+
+    @pytest.fixture()
+    def timed_app(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(0, "mlapp", None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        rng = np.random.default_rng(5)
+        evs = []
+        for day in range(30):
+            for u in range(4):
+                i = int(rng.integers(0, 6))
+                evs.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                    event_time=t0 + dt.timedelta(days=day)))
+        store.write(evs, app_id)
+        return app_id, t0
+
+    def test_sliding_eval_windows(self, memory_storage, timed_app):
+        import datetime as dt
+        from predictionio_tpu.examples import movielens as ml
+        app_id, t0 = timed_app
+        ds = ml.SlidingEvalDataSource(ml.SlidingEvalDataSourceParams(
+            appName="mlapp",
+            firstTrainingUntilTime=t0 + dt.timedelta(days=20),
+            evalDurationSeconds=5 * 86400.0,
+            evalCount=2))
+        ctx = WorkflowContext(storage=memory_storage)
+        sets = ds.read_eval(ctx)
+        assert len(sets) == 2
+        (td1, _, qa1), (td2, _, qa2) = sets
+        # window 2 trains on strictly more history
+        assert td2.n > td1.n
+        assert td1.n == 20 * 4
+        assert td2.n == 25 * 4
+        # no test event leaks into its own training window
+        assert qa1 and qa2
+
+
+class TestStock:
+    """scala-stock parity: indicators, regression strategy, backtesting."""
+
+    @staticmethod
+    def write_prices(path, days=300, seed=11):
+        rng = np.random.default_rng(seed)
+        # TREND has persistent upward drift (predictable); NOISE is a fair
+        # coin; FLAT barely moves
+        trend = 100 * np.exp(np.cumsum(rng.normal(0.002, 0.01, days)))
+        noise = 100 * np.exp(np.cumsum(rng.normal(0.0, 0.02, days)))
+        flat = np.full(days, 50.0) + rng.normal(0, 0.01, days)
+        lines = ["date,TREND,NOISE,FLAT"]
+        for d in range(days):
+            lines.append(f"d{d},{trend[d]:.4f},{noise[d]:.4f},{flat[d]:.4f}")
+        path.write_text("\n".join(lines) + "\n")
+
+    def test_indicators(self):
+        from predictionio_tpu.examples import stock as st
+        lp = np.log(np.linspace(100, 200, 50))
+        sh = st.ShiftsIndicator(5).get_training(lp)
+        np.testing.assert_allclose(sh[5:], lp[5:] - lp[:-5])
+        assert sh[:5].tolist() == [0.0] * 5
+        # RSI of a monotonically rising series saturates at 100
+        rsi = st.RSIIndicator(14).get_training(lp)
+        assert rsi[-1] == pytest.approx(100.0)
+        assert rsi[0] == 50.0   # neutral before enough history
+        # falling series -> 0
+        rsi_dn = st.RSIIndicator(14).get_training(lp[::-1].copy())
+        assert rsi_dn[-1] == pytest.approx(0.0)
+
+    def test_regression_strategy_and_backtest(self, tmp_path, memory_storage):
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.examples import stock as st
+        f = tmp_path / "prices.csv"
+        self.write_prices(f)
+        engine = st.engine()
+        dsp = st.StockDataSourceParams(
+            filepath=str(f), trainUntilIdx=250, evalInterval=10,
+            evalCount=3)
+        ep = EngineParams(
+            data_source_params=dsp,
+            algorithm_params_list=(
+                ("", st.RegressionStrategyParams(shifts=(1, 5, 22))),))
+        # plain train + predict
+        models = engine.train(WorkflowContext(storage=memory_storage), ep)
+        model = models[0]
+        assert model.coef.shape == (3, 5)     # 3 shifts + RSI + intercept
+        algo = st.RegressionStrategyAlgorithm(
+            st.RegressionStrategyParams(shifts=(1, 5, 22)))
+        pred = algo.predict(model, st.QueryDate(idx=249))
+        assert set(pred.data) == {"TREND", "NOISE", "FLAT"}
+        # the drift stock must get a higher predicted return than the flat
+        assert pred.data["TREND"] > pred.data["FLAT"]
+
+        class StockEval(Evaluation):
+            engine = st.engine()
+            metric = st.BacktestingMetric(st.BacktestingParams(
+                enterThreshold=0.0005, exitThreshold=0.0,
+                maxPositions=2))
+
+        ev = StockEval()
+        ctx = WorkflowContext(storage=memory_storage)
+        result = run_evaluation(ctx, ev, [ep], evaluation_class="StockEval")
+        bt = ev.metric.last_result
+        assert bt is not None and bt.days > 0
+        assert len(bt.nav) == bt.days + 1
+        # NAV walk is marked to market: all positive, finite
+        assert all(np.isfinite(bt.nav)) and min(bt.nav) > 0
+
+    def test_rsi_bounded_on_mixed_series(self):
+        """Mixed up/down windows must stay in [0,100] (loss magnitudes,
+        not the reference's signed series which explodes the range)."""
+        from predictionio_tpu.examples import stock as st
+        rng = np.random.default_rng(3)
+        lp = np.cumsum(rng.normal(0, 0.02, 500))
+        rsi = st.RSIIndicator(14).get_training(lp)
+        assert np.all(rsi >= 0.0) and np.all(rsi <= 100.0)
+        assert rsi[50:].std() > 1.0     # actually varies
+
+    def test_eval_predictions_use_query_day_history(self, tmp_path):
+        """Two days in one eval window must get different predictions
+        (indicators recomputed from each day's observable history)."""
+        from predictionio_tpu.examples import stock as st
+        f = tmp_path / "prices.csv"
+        self.write_prices(f)
+        dsp = st.StockDataSourceParams(
+            filepath=str(f), trainUntilIdx=250, evalInterval=10,
+            evalCount=1)
+        ds = st.StockDataSource(dsp)
+        sets = ds.read_eval(None)
+        (train, _, qa) = sets[0]
+        algo = st.RegressionStrategyAlgorithm()
+        model = algo.train(None, train)
+        p0 = algo.predict(model, qa[0][0])
+        p5 = algo.predict(model, qa[5][0])
+        assert p0.data != p5.data

@@ -101,3 +101,32 @@ def test_find_columnar_missing_rating_nan(memory_storage, app):
     ], app)
     col = store.find_columnar("testapp", event_names=["view"])
     assert np.isnan(col.rating[0])
+
+
+def test_extract_entity_map(memory_storage, app):
+    import datetime as dt
+    from predictionio_tpu.data.bimap import EntityMap
+
+    def setp(eid, props, minute):
+        return Event(
+            event="$set", entity_type="item", entity_id=eid,
+            properties=DataMap(props),
+            event_time=dt.datetime(2021, 1, 1, 0, minute,
+                                   tzinfo=dt.timezone.utc))
+    store.write([
+        setp("i1", {"price": 9.5, "cat": "a"}, 0),
+        setp("i2", {"price": 3.0, "cat": "b"}, 1),
+        setp("i3", {"cat": "c"}, 2),          # missing price -> required drops
+    ], app)
+    em = store.extract_entity_map(
+        "testapp", "item",
+        lambda dm: (dm.get_float("price"), dm.get_str("cat")),
+        required=["price"])
+    assert isinstance(em, EntityMap) and len(em) == 2
+    assert em.data("i1") == (9.5, "a")
+    # dense ix round-trips positionally
+    assert em.data(em.id_to_ix("i2")) == (3.0, "b")
+    # extraction failure names the entity
+    with pytest.raises(store.StoreError, match="i1|i2"):
+        store.extract_entity_map("testapp", "item",
+                                 lambda dm: dm.get_float("nope"))

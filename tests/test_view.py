@@ -169,3 +169,16 @@ class TestDataViewCreate:
         with pytest.raises(ValueError, match="inconsistent"):
             view.create("viewapp", bad, name="bad",
                         base_dir=str(tmp_path), storage=memory_storage)
+
+
+def test_out_of_range_int_rejected_before_cache_write(
+        memory_storage, app, tmp_path):
+    seed(app)
+    def huge(e):
+        if e.event != "buy":
+            return None
+        return {"id": 2 ** 64}
+    with pytest.raises(ValueError, match="int64"):
+        view.create("viewapp", huge, name="huge",
+                    base_dir=str(tmp_path), storage=memory_storage)
+    assert not list(tmp_path.glob("*.npz"))
