@@ -27,7 +27,7 @@ from predictionio_tpu.controller import (EmptyEvaluationInfo, Engine, Params,
 from predictionio_tpu.data import store
 from predictionio_tpu.models.recommendation.data_source import (
     DataSource as RecDataSource, DataSourceParams as RecDataSourceParams,
-    TrainingData)
+    TrainingData, training_data_from_columnar)
 from predictionio_tpu.models.recommendation.engine import (ActualResult,
                                                            PredictedResult,
                                                            Query, Rating)
@@ -105,14 +105,7 @@ class SlidingEvalDataSource(RecDataSource):
             event_names=["rate", "buy"], target_entity_type="item",
             rating_property="rating",
             storage=getattr(ctx, "storage", None))
-        rating = col.rating.copy()
-        if "buy" in col.event_names:
-            rating[col.event_name_idx ==
-                   col.event_names.index("buy")] = 4.0
-        td = TrainingData(
-            user_idx=col.entity_idx, item_idx=col.target_idx,
-            rating=rating.astype(np.float32),
-            user_vocab=col.entity_ids, item_vocab=col.target_ids)
+        td = training_data_from_columnar(col)
         t_ms = col.event_time_ms
         dur_ms = self.sep.evalDurationSeconds * 1000.0
         t0 = self.sep.firstTrainingUntilTime.timestamp() * 1000.0

@@ -304,7 +304,21 @@ class BacktestingMetric(Metric):
 
         init_cash = 1_000_000.0
         cash, positions = init_cash, {}     # ticker -> units
+        last_good: Dict[str, float] = {}    # last tradeable price seen
         navs = [init_cash]
+
+        def tradeable(t, day):
+            # active mask + finite price: a delisted/missing-price day must
+            # not divide into units or poison NAV
+            return (bool(frame.active[day, tix[t]])
+                    and np.isfinite(frame.prices[day, tix[t]])
+                    and frame.prices[day, tix[t]] > 0)
+
+        def mark(t, day):
+            if tradeable(t, day):
+                last_good[t] = float(frame.prices[day, tix[t]])
+            return last_good[t]
+
         for idx, pred, _ in days:
             if idx + 1 >= frame.prices.shape[0]:
                 break
@@ -314,15 +328,15 @@ class BacktestingMetric(Metric):
                         if v >= self.bp.enterThreshold]
             for t in to_exit:
                 if t in positions:
-                    cash += positions.pop(t) * frame.prices[idx, tix[t]]
+                    cash += positions.pop(t) * mark(t, idx)
             for t in to_enter:
                 if len(positions) >= self.bp.maxPositions:
                     break
-                if t not in positions and cash > 0:
+                if t not in positions and cash > 0 and tradeable(t, idx):
                     spend = cash / (self.bp.maxPositions - len(positions))
-                    positions[t] = spend / frame.prices[idx, tix[t]]
+                    positions[t] = spend / mark(t, idx)
                     cash -= spend
-            nav = cash + sum(u * frame.prices[idx + 1, tix[t]]
+            nav = cash + sum(u * mark(t, idx + 1)
                              for t, u in positions.items())
             navs.append(nav)
         navs_arr = np.asarray(navs)

@@ -38,9 +38,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.als import (
-    ALSData, COOSide, _CSRB_B, _csrb_plan, _half_step_explicit,
-    _half_step_explicit_csrb, _half_step_implicit, _half_step_implicit_csrb,
-    _kernel_flag, _run_segmented, _seed_factors, csrb_layout,
+    ALSData, COOSide, _CSRB_B, _HOT_K, _HYBRID_DTYPE, _csrb_plan,
+    _dense_hot_item, _dense_hot_user, _dense_min_count, _expand_X,
+    _gram_tail, _half_step_explicit, _half_step_explicit_csrb,
+    _half_step_implicit, _half_step_implicit_csrb, _kernel_flag, _reg_vec,
+    _run_segmented, _seed_factors, bucket_units, csrb_layout, solve_factors,
 )
 
 
@@ -183,6 +185,114 @@ def _pad_factors(F: np.ndarray, side: ShardedSide) -> np.ndarray:
     return out
 
 
+@dataclass
+class HybridShard:
+    """Per-device hybrid layout: dense-hot coefficients + cold csrb tails.
+
+    Mirrors ops.als.HybridData in the padded address space: `D` holds each
+    device's user-row slots x (2K) hot coefficients; `hot_addr` the K hot
+    items' PADDED addresses (item-side deal), replicated so every device
+    gathers the same X_hot; the cold tails are (n_dev * nnz_cold_dev,)
+    flats in the same sorted-by-local-row layout the csrb path ships."""
+    D: np.ndarray              # (n_rows_pad_u, 2K) float32 (bf16 at put)
+    hot_addr: np.ndarray       # (K,) int32 padded item addresses
+    u_oi: np.ndarray           # (n_dev * u_nnz_cold,) int32
+    u_rat: np.ndarray
+    u_cc: np.ndarray           # (n_rows_pad_u,) int32 cold counts per slot
+    i_oi: np.ndarray
+    i_rat: np.ndarray
+    i_cc: np.ndarray
+    u_nnz_cold: int
+    i_nnz_cold: int
+    K: int
+
+
+def _cold_flat(side: ShardedSide, hot: np.ndarray, n_dev: int
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Compact one orientation's non-hot entries per device, preserving the
+    sorted-by-local-row order, zero-padded to a common bucketed width
+    (csrb_layout reads entries through the counts cumsum, so trailing
+    zero padding is never touched)."""
+    nnz_dev, rows_dev = side.nnz_dev, side.rows_dev
+    s = side.self_idx.reshape(n_dev, nnz_dev)
+    o = side.other_idx.reshape(n_dev, nnz_dev)
+    r = side.rating.reshape(n_dev, nnz_dev)
+    cold = (s < rows_dev) & ~hot.reshape(n_dev, nnz_dev)
+    per_dev = cold.sum(axis=1)
+    nnz_cold = bucket_units(int(max(per_dev.max(), 1)))
+    oi = np.zeros((n_dev, nnz_cold), dtype=np.int32)
+    rat = np.zeros((n_dev, nnz_cold), dtype=np.float32)
+    cc = np.zeros(n_dev * rows_dev, dtype=np.int32)
+    for d in range(n_dev):
+        m = cold[d]
+        k = int(per_dev[d])
+        oi[d, :k] = o[d][m]
+        rat[d, :k] = r[d][m]
+        cc[d * rows_dev:(d + 1) * rows_dev] = np.bincount(
+            s[d][m], minlength=rows_dev)[:rows_dev]
+    return oi.reshape(-1), rat.reshape(-1), cc, nnz_cold
+
+
+def _hybrid_shard_prepare(data: ALSData, su: ShardedSide, si: ShardedSide,
+                          n_dev: int, K: int, implicit: bool,
+                          alpha: float) -> HybridShard:
+    """Host-side analogue of ops.als._hybrid_prep_jit over the dealt layout.
+
+    Hot selection is GLOBAL (top-K item rows by nnz, min-count floored,
+    exactly the single-device rule), then translated into the item deal's
+    padded address space. An entry is dense iff its item is hot AND its
+    user clears the min-count conditioning floor; everything else rides the
+    per-device csrb tails."""
+    min_count = _dense_min_count()
+    counts_i = np.asarray(data.by_item.counts)
+    hot_gids = np.argsort(-counts_i, kind="stable")[:K].astype(np.int32)
+    item_ok = counts_i[hot_gids] >= min_count
+    hot_addr = si.pos[hot_gids].astype(np.int32)
+
+    # hot rank by padded item address (-1 = cold)
+    hot_rank = np.full(si.n_rows_pad, -1, dtype=np.int32)
+    hot_rank[hot_addr[item_ok]] = np.arange(K, dtype=np.int32)[item_ok]
+    dense_user = su.counts >= min_count          # by padded user address
+
+    nnz_dev_u, rows_dev_u = su.nnz_dev, su.rows_dev
+    s_u = su.self_idx.reshape(n_dev, nnz_dev_u)
+    dev_base = (np.arange(n_dev, dtype=np.int64)[:, None] * rows_dev_u)
+    u_addr = np.where(s_u < rows_dev_u, dev_base + s_u, 0).reshape(-1)
+    real_u = (su.self_idx < rows_dev_u)
+    hr_u = hot_rank[su.other_idx]
+    hot_u = real_u & (hr_u >= 0) & dense_user[u_addr]
+
+    # item orientation: same global entry set must leave the tail
+    real_i = (si.self_idx < si.rows_dev)
+    i_addr = np.where(
+        real_i,
+        (np.arange(n_dev, dtype=np.int64)[:, None] * si.rows_dev
+         + si.self_idx.reshape(n_dev, si.nnz_dev)).reshape(-1), 0)
+    hot_i = real_i & (hot_rank[i_addr] >= 0) & dense_user[si.other_idx]
+
+    # D scatter (host): rows in padded user space, cols hot rank / K + rank
+    r = su.rating
+    if implicit:
+        conf = alpha * np.abs(r)
+        av = conf
+        bv = (1.0 + conf) * (r > 0).astype(np.float32)
+    else:
+        av = np.ones_like(r)
+        bv = r
+    D = np.zeros((su.n_rows_pad, 2 * K), dtype=np.float32)
+    rows_h = u_addr[hot_u]
+    cols_h = hr_u[hot_u]
+    np.add.at(D, (rows_h, cols_h), av[hot_u])
+    np.add.at(D, (rows_h, K + cols_h), bv[hot_u])
+
+    u_oi, u_rat, u_cc, u_nnz_cold = _cold_flat(su, hot_u, n_dev)
+    i_oi, i_rat, i_cc, i_nnz_cold = _cold_flat(si, hot_i, n_dev)
+    return HybridShard(D=D, hot_addr=hot_addr,
+                       u_oi=u_oi, u_rat=u_rat, u_cc=u_cc,
+                       i_oi=i_oi, i_rat=i_rat, i_cc=i_cc,
+                       u_nnz_cold=u_nnz_cold, i_nnz_cold=i_nnz_cold, K=K)
+
+
 def _train_sharded(
     mesh: Mesh,
     data: ALSData,
@@ -203,8 +313,19 @@ def _train_sharded(
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
     su, si = prepare_sharded(data, n_dev, chunk)
-    # per-device hybrid is not implemented; hybrid maps to csrb here
-    csrb = _kernel_flag(kernel) in ("csrb", "hybrid")
+    flag = _kernel_flag(kernel)
+    if flag == "hybrid":
+        import os
+        K = int(os.environ.get("PIO_ALS_HOT_K", _HOT_K))
+        # same worthwhile-split rule as the single-device driver
+        if data.n_items >= 2 * K and data.n_users >= 2:
+            return _train_sharded_hybrid(
+                mesh, data, su, si, K, rank, iterations, lambda_, seed,
+                chunk, reg_scaling, implicit, alpha, u0, v0,
+                checkpoint_every, checkpointer)
+    # hybrid with a too-small item set degrades to csrb, like the
+    # single-device driver
+    csrb = flag in ("csrb", "hybrid")
     b = _CSRB_B
     # per-device csrb plans (static: nnz_dev is the max-padded per-device
     # entry count, rows_dev the per-device row-slot count)
@@ -313,6 +434,130 @@ def _train_sharded(
         U_pad, V_pad = jitted(*flat, U0, V0, jnp.int32(n_iters))
         # replicated outputs: every process reads its local copy, then
         # gathers padded rows back to canonical order
+        return (np.asarray(U_pad)[su.pos], np.asarray(V_pad)[si.pos])
+
+    return _run_segmented(run, u0, v0, iterations, checkpoint_every,
+                          checkpointer)
+
+
+def _train_sharded_hybrid(
+    mesh: Mesh,
+    data: ALSData,
+    su: ShardedSide,
+    si: ShardedSide,
+    K: int,
+    rank: int,
+    iterations: int,
+    lambda_: float,
+    seed: int,
+    chunk: int,
+    reg_scaling: str,
+    implicit: bool,
+    alpha: float,
+    u0,
+    v0,
+    checkpoint_every: Optional[int],
+    checkpointer,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Block-sharded hybrid kernel: the single-device dense-hot/csrb-tail
+    split (ops/als.py hybrid section), distributed.
+
+    Per device: its own (rows_dev, 2K) slice of D and its cold tails. The
+    user half-step is embarrassingly row-parallel (each device solves its
+    user slots from the all-gathered item factors). The item half-step's
+    dense part is a psum: device d contributes D_dᵀ @ expand(U_d) — the hot
+    items' Gram/RHS partials over d's users — and the device owning each
+    hot item row adds the summed result into its tail accumulator. One
+    extra (K, r²+r) psum per iteration rides the same ICI the factor
+    all-gathers use."""
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    hs = _hybrid_shard_prepare(data, su, si, n_dev, K, implicit, alpha)
+    b = _CSRB_B
+    u_mb, u_chunk = _csrb_plan(hs.u_nnz_cold, su.rows_dev, b, chunk)
+    i_mb, i_chunk = _csrb_plan(hs.i_nnz_cold, si.rows_dev, b, chunk)
+    r = rank
+
+    def step_fn(D_blk, hot_addr, u_oi, u_rat, u_cc, u_counts,
+                i_oi, i_rat, i_cc, i_counts, U0_blk, V0_blk, n_iters):
+        U = lax.all_gather(U0_blk, axis, tiled=True)
+        V = lax.all_gather(V0_blk, axis, tiled=True)
+        u_lay = csrb_layout(u_oi, u_rat, u_cc, su.rows_dev, b, u_mb)
+        i_lay = csrb_layout(i_oi, i_rat, i_cc, si.rows_dev, b, i_mb)
+        u_reg = _reg_vec(u_counts, su.rows_dev, lambda_, reg_scaling)
+        i_reg = _reg_vec(i_counts, si.rows_dev, lambda_, reg_scaling)
+        didx = lax.axis_index(axis)
+        # hot item rows owned by this device, as local rows (OOB = dropped)
+        local_hot = hot_addr - didx * si.rows_dev
+        local_hot = jnp.where((local_hot >= 0) & (local_hot < si.rows_dev),
+                              local_hot, si.rows_dev)
+
+        def one_iter(_, UV):
+            U, V = UV
+            # ---- user half-step: rows are local, V is fully gathered
+            X = _expand_X(V, r, jnp.float32)          # (n_rows_pad_i, w)
+            X_hot = jnp.take(X, hot_addr, axis=0).astype(_HYBRID_DTYPE)
+            AB = _dense_hot_user(D_blk, X_hot, K, r)
+            AB = AB + _gram_tail(X, u_lay, su.rows_dev, b, u_chunk,
+                                 implicit, alpha)
+            A = AB[:, : r * r].reshape(su.rows_dev, r, r)
+            if implicit:
+                A = A + (V.T @ V)[None]
+            U_blk = solve_factors(A, AB[:, r * r:], u_reg)
+            U = lax.all_gather(U_blk, axis, tiled=True)
+            # ---- item half-step: dense partials psum over devices
+            Z_local = _expand_X(U_blk, r, jnp.float32)
+            AB_hot = _dense_hot_item(D_blk, Z_local.astype(_HYBRID_DTYPE),
+                                     K, r)
+            AB_hot = lax.psum(AB_hot, axis)           # (K, w) full
+            Z = _expand_X(U, r, jnp.float32)
+            ABi = _gram_tail(Z, i_lay, si.rows_dev, b, i_chunk,
+                             implicit, alpha)
+            ABi = ABi.at[local_hot].add(AB_hot, mode="drop")
+            Ai = ABi[:, : r * r].reshape(si.rows_dev, r, r)
+            if implicit:
+                Ai = Ai + (U.T @ U)[None]
+            V_blk = solve_factors(Ai, ABi[:, r * r:], i_reg)
+            V = lax.all_gather(V_blk, axis, tiled=True)
+            return (U, V)
+
+        return lax.fori_loop(0, n_iters, one_iter, (U, V))
+
+    sharded = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(axis, None), P(), P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis), P(axis), P(axis),
+                  P(axis, None), P(axis, None), P()),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    jitted = jax.jit(sharded)
+
+    flat_spec = NamedSharding(mesh, P(axis))
+    row_spec = NamedSharding(mesh, P(axis, None))
+    rep_spec = NamedSharding(mesh, P())
+
+    def put(arr, spec):
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, spec, lambda idx: arr[idx])
+
+    D_dev = jax.device_put(
+        jnp.asarray(hs.D, dtype=_HYBRID_DTYPE),
+        NamedSharding(mesh, P(axis, None)))
+    hot_dev = put(hs.hot_addr, rep_spec)
+    flats = tuple(put(a, flat_spec) for a in (
+        hs.u_oi, hs.u_rat, hs.u_cc, su.counts,
+        hs.i_oi, hs.i_rat, hs.i_cc, si.counts))
+
+    if u0 is None or v0 is None:
+        u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
+
+    def run(u, v, n_iters):
+        U0 = put(_pad_factors(np.asarray(u), su), row_spec)
+        V0 = put(_pad_factors(np.asarray(v), si), row_spec)
+        U_pad, V_pad = jitted(D_dev, hot_dev, *flats, U0, V0,
+                              jnp.int32(n_iters))
         return (np.asarray(U_pad)[su.pos], np.asarray(V_pad)[si.pos])
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,

@@ -138,3 +138,63 @@ def test_sharded_checkpoint_resume(tmp_path):
                                rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(np.asarray(V), np.asarray(full_V),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_sharded_hybrid_matches_single_device(monkeypatch):
+    """The per-device hybrid kernel (dense-hot D blocks + psum'd item
+    partials) must agree with the single-device hybrid to bf16
+    accumulation tolerance, and with the f32 csrb kernel at model level
+    (the test_als.py hybrid bar)."""
+    monkeypatch.setenv("PIO_ALS_HOT_K", "16")
+    monkeypatch.setenv("PIO_ALS_DENSE_MIN_COUNT", "4")
+    ui, ii, vals = zipf_problem(seed=11)
+    data = als.prepare_ratings(ui, ii, vals, 200, 80, chunk=256)
+    mesh = get_mesh(8)
+    U2, V2 = als_dist.train_explicit_sharded(
+        mesh, data, rank=4, iterations=5, lambda_=0.05, seed=7, chunk=256,
+        kernel="hybrid")
+    U1, V1 = als.train_explicit(data, rank=4, iterations=5, lambda_=0.05,
+                                seed=7, chunk=256, kernel="hybrid")
+    Uc, Vc = als.train_explicit(data, rank=4, iterations=5, lambda_=0.05,
+                                seed=7, chunk=256, kernel="csrb")
+    U1, V1, U2, V2, Uc, Vc = map(np.asarray, (U1, V1, U2, V2, Uc, Vc))
+    # vs single-device hybrid: same split rule, same bf16 dense path
+    assert np.linalg.norm(U1 - U2) / np.linalg.norm(U1) < 0.02
+    assert np.linalg.norm(V1 - V2) / np.linalg.norm(V1) < 0.02
+    # vs f32 csrb: the established hybrid parity bar
+    assert np.linalg.norm(Uc - U2) / np.linalg.norm(Uc) < 0.02
+    assert np.linalg.norm(Vc - V2) / np.linalg.norm(Vc) < 0.02
+
+
+def test_sharded_hybrid_implicit_matches(monkeypatch):
+    monkeypatch.setenv("PIO_ALS_HOT_K", "16")
+    monkeypatch.setenv("PIO_ALS_DENSE_MIN_COUNT", "4")
+    ui, ii, vals = zipf_problem(seed=12)
+    data = als.prepare_ratings(ui, ii, np.abs(vals), 200, 80, chunk=256)
+    mesh = get_mesh(8)
+    U2, V2 = als_dist.train_implicit_sharded(
+        mesh, data, rank=4, iterations=4, lambda_=0.05, alpha=2.0, seed=5,
+        chunk=256, kernel="hybrid")
+    U1, V1 = als.train_implicit(data, rank=4, iterations=4, lambda_=0.05,
+                                alpha=2.0, seed=5, chunk=256,
+                                kernel="hybrid")
+    U1, V1, U2, V2 = map(np.asarray, (U1, V1, U2, V2))
+    assert np.linalg.norm(U1 - U2) / np.linalg.norm(U1) < 0.02
+    assert np.linalg.norm(V1 - V2) / np.linalg.norm(V1) < 0.02
+
+
+def test_sharded_hybrid_small_items_falls_back(monkeypatch):
+    """n_items < 2K: the sharded driver degrades to csrb exactly like the
+    single-device one (no hot/cold split worth building)."""
+    monkeypatch.setenv("PIO_ALS_HOT_K", "4096")
+    ui, ii, vals = make_problem(seed=6)
+    data = als.prepare_ratings(ui, ii, vals, 60, 40, chunk=64)
+    mesh = get_mesh(8)
+    U2, V2 = als_dist.train_explicit_sharded(
+        mesh, data, rank=4, iterations=3, lambda_=0.01, seed=7, chunk=64,
+        kernel="hybrid")
+    Uc, Vc = als_dist.train_explicit_sharded(
+        mesh, data, rank=4, iterations=3, lambda_=0.01, seed=7, chunk=64,
+        kernel="csrb")
+    np.testing.assert_array_equal(np.asarray(U2), np.asarray(Uc))
+    np.testing.assert_array_equal(np.asarray(V2), np.asarray(Vc))

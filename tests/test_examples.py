@@ -641,3 +641,25 @@ class TestStock:
         p0 = algo.predict(model, qa[0][0])
         p5 = algo.predict(model, qa[5][0])
         assert p0.data != p5.data
+
+    def test_backtest_survives_delisting(self):
+        """A ticker losing its price mid-eval must not NaN the NAV walk:
+        inactive days can't be entered, marks fall back to the last
+        tradeable price."""
+        from predictionio_tpu.examples import stock as st
+        days = 30
+        prices = np.full((days, 2), 100.0)
+        prices[:, 1] = 50.0
+        prices[20:, 1] = np.nan              # DEAD delists at day 20
+        frame = st.StockTrainingData(
+            tickers=["LIVE", "DEAD"], prices=prices,
+            active=np.isfinite(prices) & (prices > 0))
+        metric = st.BacktestingMetric(st.BacktestingParams(
+            enterThreshold=0.0, exitThreshold=-1.0, maxPositions=2))
+        qa = [(st.QueryDate(idx=d),
+               st.StockPrediction(data={"LIVE": 0.01, "DEAD": 0.01}),
+               frame) for d in range(15, 28)]
+        sharpe = metric.calculate([(None, qa)])
+        bt = metric.last_result
+        assert all(np.isfinite(bt.nav)), bt.nav
+        assert np.isfinite(bt.ret)
