@@ -185,6 +185,16 @@ def _pad_factors(F: np.ndarray, side: ShardedSide) -> np.ndarray:
     return out
 
 
+def _shard_put(arr: np.ndarray, spec: NamedSharding):
+    """Host array -> sharded device array. Every process holds the full
+    host array (they all read the same event store), so each one just
+    donates its addressable shards — works identically on a single- or
+    multi-controller runtime."""
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, spec, lambda idx: arr[idx])
+
+
 @dataclass
 class HybridShard:
     """Per-device hybrid layout: dense-hot coefficients + cold csrb tails.
@@ -415,13 +425,7 @@ def _train_sharded(
     flat_spec = NamedSharding(mesh, P(axis))
     row_spec = NamedSharding(mesh, P(axis, None))
 
-    def put(arr, spec):
-        # every process holds the full host array (they all read the same
-        # event store), so each one just donates its addressable shards —
-        # works identically on a single- or multi-controller runtime
-        arr = np.asarray(arr)
-        return jax.make_array_from_callback(
-            arr.shape, spec, lambda idx: arr[idx])
+    put = _shard_put
 
     flat = tuple(put(a, flat_spec) for a in side_arrays)
 
@@ -537,14 +541,12 @@ def _train_sharded_hybrid(
     row_spec = NamedSharding(mesh, P(axis, None))
     rep_spec = NamedSharding(mesh, P())
 
-    def put(arr, spec):
-        arr = np.asarray(arr)
-        return jax.make_array_from_callback(
-            arr.shape, spec, lambda idx: arr[idx])
+    put = _shard_put
 
-    D_dev = jax.device_put(
-        jnp.asarray(hs.D, dtype=_HYBRID_DTYPE),
-        NamedSharding(mesh, P(axis, None)))
+    # bf16 on host (jnp.bfloat16 IS ml_dtypes.bfloat16, a numpy dtype), so
+    # the 2K-wide D ships once at half width with no device round-trip
+    D_dev = put(hs.D.astype(_HYBRID_DTYPE), NamedSharding(mesh, P(axis, None)))
+    hs.D = None   # drop the f32 original (GBs at bench scale)
     hot_dev = put(hs.hot_addr, rep_spec)
     flats = tuple(put(a, flat_spec) for a in (
         hs.u_oi, hs.u_rat, hs.u_cc, su.counts,

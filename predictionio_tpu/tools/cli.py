@@ -381,6 +381,10 @@ def cmd_template(args) -> int:
     _info("Instantiate one by pointing engine.json's engineFactory at its "
           "factory, e.g. predictionio_tpu.models.recommendation:"
           "RecommendationEngine.")
+    _info("Demo engines (the reference's examples/experimental set) live "
+          "in predictionio_tpu.examples.* — helloworld, regression, "
+          "friend_recommendation, dimsum, recommendation_variants, apps, "
+          "movielens, stock; see that package's docstring for the map.")
     return 0
 
 

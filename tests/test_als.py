@@ -268,3 +268,38 @@ def test_hybrid_below_floor_hot_items_stay_on_tail(monkeypatch):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(V1), np.asarray(V2),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_layout_cache_reused_across_variants(memory_storage):
+    """Two trains over the SAME TrainingData (the FastEval grid shape)
+    compute the COO layout once; a different TrainingData gets its own."""
+    from unittest import mock
+
+    from predictionio_tpu.models.recommendation.als_algorithm import (
+        ALSAlgorithm, ALSAlgorithmParams)
+    from predictionio_tpu.models.recommendation.data_source import (
+        TrainingData)
+    from predictionio_tpu.models.recommendation.preparator import (
+        PreparedData)
+    from predictionio_tpu.data.bimap import BiMap
+
+    rng = np.random.default_rng(0)
+    n = 500
+    td = TrainingData(
+        user_idx=rng.integers(0, 40, n).astype(np.int32),
+        item_idx=rng.integers(0, 30, n).astype(np.int32),
+        rating=rng.uniform(1, 5, n).astype(np.float32),
+        user_vocab=BiMap.string_int(f"u{k}" for k in range(40)),
+        item_vocab=BiMap.string_int(f"i{k}" for k in range(30)))
+    pd = PreparedData(ratings=td)
+    real = als.prepare_ratings
+    with mock.patch.object(als, "prepare_ratings",
+                           side_effect=real) as spy:
+        ALSAlgorithm(ALSAlgorithmParams(rank=4, numIterations=2,
+                                        seed=1)).train(None, pd)
+        ALSAlgorithm(ALSAlgorithmParams(rank=6, numIterations=2,
+                                        seed=2)).train(None, pd)
+        assert spy.call_count == 1          # second variant reused layout
+    m1 = ALSAlgorithm(ALSAlgorithmParams(rank=4, numIterations=3,
+                                         seed=3)).train(None, pd)
+    assert m1.user_factors.shape == (40, 4)

@@ -49,8 +49,19 @@ data = als.prepare_ratings(u, i, r, n_u, n_i)
 mesh = get_mesh()                          # all 8 GLOBAL devices
 U, V = als_dist.train_explicit_sharded(mesh, data, rank=5, iterations=4,
                                        lambda_=0.05, seed=9)
+
+# hybrid kernel across the same two-process mesh: the dense-hot psum and
+# per-device D shards must also work over DCN (K lowered so the split
+# engages at this scale)
+os.environ["PIO_ALS_HOT_K"] = "8"
+os.environ["PIO_ALS_DENSE_MIN_COUNT"] = "4"
+Uh, Vh = als_dist.train_explicit_sharded(mesh, data, rank=5, iterations=4,
+                                         lambda_=0.05, seed=9,
+                                         kernel="hybrid")
 with open(out_path, "w") as f:
     json.dump({"U": np.asarray(U).tolist(), "V": np.asarray(V).tolist(),
+               "Uh": np.asarray(Uh).tolist(),
+               "Vh": np.asarray(Vh).tolist(),
                "process_count": jax.process_count()}, f)
 """
 
@@ -112,4 +123,22 @@ def test_two_process_mesh_matches_single_process(tmp_path):
     np.testing.assert_allclose(np.asarray(got[0]["U"]), np.asarray(U),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got[0]["V"]), np.asarray(V),
+                               rtol=1e-5, atol=1e-6)
+
+    # hybrid leg: two-process result matches a single-process 8-device
+    # hybrid run (same K/min-count env as the workers)
+    os.environ["PIO_ALS_HOT_K"] = "8"
+    os.environ["PIO_ALS_DENSE_MIN_COUNT"] = "4"
+    try:
+        Uh, Vh = als_dist.train_explicit_sharded(
+            get_mesh(8), data, rank=5, iterations=4, lambda_=0.05, seed=9,
+            kernel="hybrid")
+    finally:
+        del os.environ["PIO_ALS_HOT_K"]
+        del os.environ["PIO_ALS_DENSE_MIN_COUNT"]
+    np.testing.assert_array_equal(np.asarray(got[0]["Uh"]),
+                                  np.asarray(got[1]["Uh"]))
+    np.testing.assert_allclose(np.asarray(got[0]["Uh"]), np.asarray(Uh),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[0]["Vh"]), np.asarray(Vh),
                                rtol=1e-5, atol=1e-6)
