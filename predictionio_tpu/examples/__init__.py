@@ -34,5 +34,7 @@ scala-local-movielens-evaluation  movielens (ItemRecEvaluation)
 scala-stock                       stock (indicators, vmapped regression
                                     strategy, backtesting evaluator)
 scala-recommendations             covered by models/recommendation
+similarproduct/recommended-user   recommended_user (from the supported
+  (examples/scala-parallel-...)     template family's variant set)
 ================================  =======================================
 """

@@ -81,9 +81,14 @@ class ALSAlgorithm(Algorithm):
         with layout:
             # the COO layout is rank-independent, so an eval grid's variants
             # sharing one fold (FastEval memoizes the PreparedData object)
-            # reuse it instead of re-sorting the same ratings per variant
+            # reuse it instead of re-sorting the same ratings per variant.
+            # Only eval-scale data is cached: a full-scale single train is
+            # laid out once anyway, and pinning its device-resident layout
+            # to the TrainingData would extend 100s of MB of HBM past train
+            cacheable = td.n <= 2_000_000
             cache_key = ("als_layout", use_mesh)
-            cached = getattr(td, "_pio_layout_cache", None)
+            cached = getattr(td, "_pio_layout_cache", None) \
+                if cacheable else None
             if cached is not None and cached[0] == cache_key:
                 data = cached[1]
             else:
@@ -102,7 +107,8 @@ class ALSAlgorithm(Algorithm):
 
                     jax.device_get((data.by_user.self_idx[-1:],
                                     data.by_item.self_idx[-1:]))
-                td._pio_layout_cache = (cache_key, data)
+                if cacheable:
+                    td._pio_layout_cache = (cache_key, data)
         checkpointer = None
         ckpt_dir = getattr(ctx, "checkpoint_dir", None)
         if self.ap.checkpointInterval and ckpt_dir:

@@ -545,7 +545,7 @@ def _train_sharded_hybrid(
 
     # bf16 on host (jnp.bfloat16 IS ml_dtypes.bfloat16, a numpy dtype), so
     # the 2K-wide D ships once at half width with no device round-trip
-    D_dev = put(hs.D.astype(_HYBRID_DTYPE), NamedSharding(mesh, P(axis, None)))
+    D_dev = put(hs.D.astype(_HYBRID_DTYPE), row_spec)
     hs.D = None   # drop the f32 original (GBs at bench scale)
     hot_dev = put(hs.hot_addr, rep_spec)
     flats = tuple(put(a, flat_spec) for a in (

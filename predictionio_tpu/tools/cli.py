@@ -383,8 +383,9 @@ def cmd_template(args) -> int:
           "RecommendationEngine.")
     _info("Demo engines (the reference's examples/experimental set) live "
           "in predictionio_tpu.examples.* — helloworld, regression, "
-          "friend_recommendation, dimsum, recommendation_variants, apps, "
-          "movielens, stock; see that package's docstring for the map.")
+          "friend_recommendation, dimsum, recommendation_variants, "
+          "recommended_user, apps, movielens, stock; see that package's "
+          "docstring for the map.")
     return 0
 
 

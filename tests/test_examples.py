@@ -663,3 +663,53 @@ class TestStock:
         bt = metric.last_result
         assert all(np.isfinite(bt.nav)), bt.nav
         assert np.isfinite(bt.ret)
+
+
+class TestRecommendedUser:
+    """similarproduct/recommended-user parity: follow -> similar users."""
+
+    @pytest.fixture()
+    def app(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(0, "ruapp", None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        evs = [Event(event="$set", entity_type="user", entity_id=f"u{k}",
+                     event_time=t0) for k in range(5)]
+        # u0 and u1 follow the same people (u3, u4); u2 follows only u3
+        follows = [("u0", "u3"), ("u0", "u4"), ("u1", "u3"), ("u1", "u4"),
+                   ("u2", "u3"), ("u0", "u3")]       # dup deduped
+        for n, (a, b) in enumerate(follows):
+            evs.append(Event(event="follow", entity_type="user",
+                             entity_id=a, target_entity_type="user",
+                             target_entity_id=b,
+                             event_time=t0 + dt.timedelta(minutes=n)))
+        store.write(evs, app_id)
+        return app_id
+
+    def test_similar_users(self, memory_storage, app):
+        from predictionio_tpu.examples import recommended_user as ru
+        engine = ru.engine()
+        ep = EngineParams(
+            data_source_params=ru.RUDataSourceParams("ruapp"),
+            algorithm_params_list=(
+                ("als", ru.RUALSParams(rank=4, numIterations=10, seed=5)),))
+        ctx = WorkflowContext(storage=memory_storage)
+        model = engine.train(ctx, ep)[0]
+        algo = ru.RUALSAlgorithm()
+        # u3 and u4 are followed by the same users -> most similar pair
+        r = algo.predict(model, ru.RUQuery(users=("u3",), num=2))
+        assert r.similarUserScores
+        assert r.similarUserScores[0].user == "u4"
+        assert all(s.user != "u3" for s in r.similarUserScores)  # excluded
+        # blackList removes the top pick
+        r = algo.predict(model, ru.RUQuery(users=("u3",), num=2,
+                                           blackList=("u4",)))
+        assert all(s.user != "u4" for s in r.similarUserScores)
+        # unseen seed users -> empty
+        assert algo.predict(model, ru.RUQuery(users=("zz",), num=2)
+                            ).similarUserScores == ()

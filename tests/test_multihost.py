@@ -72,7 +72,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_matches_single_process(tmp_path):
+def test_two_process_mesh_matches_single_process(tmp_path, monkeypatch):
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
@@ -127,15 +127,11 @@ def test_two_process_mesh_matches_single_process(tmp_path):
 
     # hybrid leg: two-process result matches a single-process 8-device
     # hybrid run (same K/min-count env as the workers)
-    os.environ["PIO_ALS_HOT_K"] = "8"
-    os.environ["PIO_ALS_DENSE_MIN_COUNT"] = "4"
-    try:
-        Uh, Vh = als_dist.train_explicit_sharded(
-            get_mesh(8), data, rank=5, iterations=4, lambda_=0.05, seed=9,
-            kernel="hybrid")
-    finally:
-        del os.environ["PIO_ALS_HOT_K"]
-        del os.environ["PIO_ALS_DENSE_MIN_COUNT"]
+    monkeypatch.setenv("PIO_ALS_HOT_K", "8")
+    monkeypatch.setenv("PIO_ALS_DENSE_MIN_COUNT", "4")
+    Uh, Vh = als_dist.train_explicit_sharded(
+        get_mesh(8), data, rank=5, iterations=4, lambda_=0.05, seed=9,
+        kernel="hybrid")
     np.testing.assert_array_equal(np.asarray(got[0]["Uh"]),
                                   np.asarray(got[1]["Uh"]))
     np.testing.assert_allclose(np.asarray(got[0]["Uh"]), np.asarray(Uh),
