@@ -23,6 +23,7 @@ from predictionio_tpu.controller.base import Algorithm
 from predictionio_tpu.data import store
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.ops import als
+from predictionio_tpu.ops.topk import host_topk
 
 
 @dataclass(frozen=True)
@@ -174,13 +175,11 @@ class RUALSAlgorithm(Algorithm):
                 if ix is not None:
                     eligible[ix] = False
         agg = np.where(eligible & (agg > 0), agg, -np.inf)
-        k = min(query.num, agg.shape[0])
-        idx = np.argpartition(-agg, k - 1)[:k]
-        idx = idx[np.argsort(-agg[idx], kind="stable")]
+        vals, idx = host_topk(agg, query.num)
         inv = vocab.inverse()
         return RUPredictedResult(similarUserScores=tuple(
-            SimilarUserScore(user=inv(int(i)), score=float(agg[i]))
-            for i in idx if np.isfinite(agg[i])))
+            SimilarUserScore(user=inv(int(i)), score=float(v))
+            for v, i in zip(vals, idx) if np.isfinite(v)))
 
 
 def engine() -> Engine:

@@ -190,6 +190,10 @@ class ALSAlgorithm(Algorithm):
             # unknown user -> empty result (ALSAlgorithm.scala:104-108)
             return PredictedResult(())
         k = min(query.num, len(model.item_vocab))
+        if k <= 0:
+            # num <= 0 straight from request JSON: empty, not a device
+            # error (lax.top_k rejects negative k)
+            return PredictedResult(())
         if isinstance(model.user_factors, np.ndarray):
             # host serving: one BLAS matvec + argpartition
             scores = model.item_factors @ model.user_factors[user_ix]

@@ -61,10 +61,14 @@ def host_masked_topk(factors, query_vec, mask, k: int):
 
 def host_topk(scores, k: int):
     """numpy argpartition top-K for host-side serving (small models or
-    remote devices where per-query dispatch latency dominates)."""
+    remote devices where per-query dispatch latency dominates). k <= 0
+    (e.g. a negative `num` straight from request JSON) returns empty —
+    a negative argpartition slice would return nearly ALL entries."""
     import numpy as np
 
     k = min(k, scores.shape[-1])
+    if k <= 0:
+        return scores[:0], np.zeros((0,), dtype=np.int64)
     idx = np.argpartition(-scores, k - 1)[:k]
     idx = idx[np.argsort(-scores[idx], kind="stable")]
     return scores[idx], idx

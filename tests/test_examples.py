@@ -713,3 +713,32 @@ class TestRecommendedUser:
         # unseen seed users -> empty
         assert algo.predict(model, ru.RUQuery(users=("zz",), num=2)
                             ).similarUserScores == ()
+
+
+def test_example_engine_drives_through_engine_json(tmp_path, memory_storage):
+    """Example engines must be front-door engines: engine.json factory
+    resolution + typed params extraction + run_train (the reference's
+    experimental engines each ship an engine.json)."""
+    from predictionio_tpu.workflow import run_train
+    from predictionio_tpu.workflow.workflow_utils import get_engine
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (150, 3))
+    y = x @ np.array([2.0, -1.0, 0.5]) + 0.25
+    data = tmp_path / "lr_data.txt"
+    np.savetxt(data, np.column_stack([y, x]), fmt="%.6f")
+    variant = {
+        "id": "default",
+        "engineFactory": "predictionio_tpu.examples.regression:engine",
+        "datasource": {"params": {"filepath": str(data), "k": 3}},
+        "algorithms": [
+            {"name": "SGD",
+             "params": {"numIterations": 200, "stepSize": 0.5}}],
+    }
+    engine = get_engine(variant["engineFactory"])
+    ep = engine.engine_params_from_json(variant)
+    assert ep.algorithm_params_list[0][1].stepSize == 0.5
+    ctx = WorkflowContext(storage=memory_storage)
+    iid = run_train(ctx, engine, ep, engine_factory=variant["engineFactory"],
+                    params_json=variant)
+    assert memory_storage.get_model_data_models().get(iid) is not None

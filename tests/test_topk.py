@@ -38,3 +38,14 @@ def test_cosine_topk_scale_invariant():
     # cosine ignores magnitude: item0 (parallel) wins with score 1
     assert int(np.asarray(idx)[0]) == 0
     np.testing.assert_allclose(float(np.asarray(vals)[0]), 1.0, rtol=1e-5)
+
+
+def test_host_topk_nonpositive_k_returns_empty():
+    """A negative num from request JSON must not return ~all entries
+    (negative argpartition slice keeps n+k elements)."""
+    import numpy as np
+    from predictionio_tpu.ops.topk import host_topk
+    scores = np.array([3.0, 1.0, 2.0])
+    for k in (0, -1, -3):
+        vals, idx = host_topk(scores, k)
+        assert vals.size == 0 and idx.size == 0
