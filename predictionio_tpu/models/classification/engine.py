@@ -26,16 +26,22 @@ class PredictedResult:
 
 
 def ClassificationEngine():
-    """Engine factory (Engine.scala object ClassificationEngine)."""
+    """Engine factory (Engine.scala object ClassificationEngine; the
+    add-algorithm tutorial's map carries both "naive" and
+    "randomforest")."""
     from predictionio_tpu.controller import Engine, FirstServing, IdentityPreparator
     from predictionio_tpu.models.classification.data_source import DataSource
     from predictionio_tpu.models.classification.nb_algorithm import (
         NaiveBayesAlgorithm,
     )
+    from predictionio_tpu.models.classification.random_forest import (
+        RandomForestAlgorithm,
+    )
 
     return Engine(
         data_source_class=DataSource,
         preparator_class=IdentityPreparator,
-        algorithm_class_map={"naive": NaiveBayesAlgorithm},
+        algorithm_class_map={"naive": NaiveBayesAlgorithm,
+                             "randomforest": RandomForestAlgorithm},
         serving_class=FirstServing,
     )

@@ -223,13 +223,16 @@ class ALSAlgorithm(Algorithm):
             return out
         max_num = max(q.num for _qx, q, _ix in valid)
         k = min(max_num, len(model.item_vocab))
+        if k <= 0:      # every query asked for num <= 0
+            out.extend((qx, PredictedResult(())) for qx, _q, _ix in valid)
+            return out
         U = np.asarray(model.user_factors)
         ixs = np.asarray([ix for _qx, _q, ix in valid], dtype=np.int32)
         vals, idx = topk.topk_scores_batch(U[ixs], model.item_factors, k=k)
         vals, idx = np.asarray(vals), np.asarray(idx)
         inv = model.item_vocab.inverse()
         for row, (qx, q, _ix) in enumerate(valid):
-            n = min(q.num, k)
+            n = max(min(q.num, k), 0)   # a negative num is empty, not top-n
             out.append((qx, PredictedResult(tuple(
                 ItemScore(item=inv(int(i)), score=float(s))
                 for s, i in zip(vals[row, :n], idx[row, :n])))))

@@ -303,3 +303,37 @@ def test_layout_cache_reused_across_variants(memory_storage):
     m1 = ALSAlgorithm(ALSAlgorithmParams(rank=4, numIterations=3,
                                          seed=3)).train(None, pd)
     assert m1.user_factors.shape == (40, 4)
+
+
+def test_batch_predict_clamps_nonpositive_num(memory_storage):
+    """Eval-path parity with predict(): num <= 0 yields empty results, and
+    an all-nonpositive batch must not reach lax.top_k with negative k."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.recommendation.als_algorithm import (
+        ALSAlgorithm, ALSAlgorithmParams)
+    from predictionio_tpu.models.recommendation.data_source import (
+        TrainingData)
+    from predictionio_tpu.models.recommendation.engine import Query
+    from predictionio_tpu.models.recommendation.preparator import (
+        PreparedData)
+
+    rng = np.random.default_rng(1)
+    n = 300
+    td = TrainingData(
+        user_idx=rng.integers(0, 20, n).astype(np.int32),
+        item_idx=rng.integers(0, 15, n).astype(np.int32),
+        rating=rng.uniform(1, 5, n).astype(np.float32),
+        user_vocab=BiMap.string_int(f"u{k}" for k in range(20)),
+        item_vocab=BiMap.string_int(f"i{k}" for k in range(15)))
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=4, numIterations=2, seed=1))
+    model = algo.train(None, PreparedData(ratings=td))
+    res = dict(algo.batch_predict(model, [
+        (0, Query(user="u1", num=-1)),
+        (1, Query(user="u2", num=3)),
+        (2, Query(user="u3", num=0))]))
+    assert res[0].itemScores == () and res[2].itemScores == ()
+    assert len(res[1].itemScores) == 3
+    # all-nonpositive batch: no device call, all empty
+    res2 = dict(algo.batch_predict(model, [
+        (0, Query(user="u1", num=0)), (1, Query(user="u2", num=-5))]))
+    assert all(r.itemScores == () for r in res2.values())

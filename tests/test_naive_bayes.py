@@ -48,3 +48,64 @@ def test_single_sample_predict():
                      np.array([0, 1], dtype=np.int32))
     assert int(nb.predict(model, np.array([5.0, 0.0]))[0]) == 0
     assert int(nb.predict(model, np.array([0.0, 5.0]))[0]) == 1
+
+
+class TestRandomForest:
+    """add-algorithm tutorial's RandomForestAlgorithm variant."""
+
+    @staticmethod
+    def xor_data(n=400, seed=0):
+        """XOR-ish: NB (linear in log space) cannot separate; trees can."""
+        rng = np.random.default_rng(seed)
+        x = rng.uniform(-1, 1, (n, 4))
+        y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.float64) * 2 + 1.0
+        return x, y     # labels 1.0 / 3.0 (plan-id style floats)
+
+    def make_td(self, x, y):
+        from predictionio_tpu.models.classification.data_source import (
+            LabeledPoint, TrainingData)
+        return TrainingData(labeled_points=[
+            LabeledPoint(label=float(lbl),
+                         features=tuple(float(v) for v in row))
+            for row, lbl in zip(x, y)])
+
+    def test_forest_learns_xor_and_nb_cannot(self):
+        from predictionio_tpu.models.classification.engine import Query
+        from predictionio_tpu.models.classification.random_forest import (
+            RandomForestAlgorithm, RandomForestAlgorithmParams)
+        x, y = self.xor_data()
+        td = self.make_td(x, y)
+        algo = RandomForestAlgorithm(RandomForestAlgorithmParams(
+            numClasses=2, numTrees=15, maxDepth=6, seed=3))
+        model = algo.train(None, td)
+        xt, yt = self.xor_data(n=200, seed=1)
+        preds = np.array([algo.predict(model, Query(tuple(row))).label
+                          for row in xt])
+        acc = float((preds == yt).mean())
+        assert acc > 0.9, acc
+        # labels round-trip as the original floats
+        assert set(preds.tolist()) <= {1.0, 3.0}
+        # the contrast in the name: NB's linear decision stays near chance
+        from predictionio_tpu.models.classification.nb_algorithm import (
+            NaiveBayesAlgorithm, NaiveBayesAlgorithmParams)
+        nb = NaiveBayesAlgorithm(NaiveBayesAlgorithmParams(lambda_=1.0))
+        nb_model = nb.train(None, td)
+        nb_preds = np.array([nb.predict(nb_model, Query(tuple(row))).label
+                             for row in xt])
+        nb_acc = float((nb_preds == yt).mean())
+        assert nb_acc < 0.7, nb_acc
+
+    def test_params_surface_matches_reference(self):
+        from predictionio_tpu.models.classification.random_forest import (
+            RandomForestAlgorithmParams)
+        p = RandomForestAlgorithmParams(
+            numClasses=3, numTrees=5, featureSubsetStrategy="sqrt",
+            impurity="entropy", maxDepth=4, maxBins=16)
+        assert (p.numClasses, p.numTrees, p.impurity) == (3, 5, "entropy")
+
+    def test_single_tree_auto_uses_all_features(self):
+        from predictionio_tpu.models.classification.random_forest import (
+            _n_features_per_split)
+        assert _n_features_per_split("auto", 9, 1) == 9      # MLlib rule
+        assert _n_features_per_split("auto", 9, 10) == 3
+        assert _n_features_per_split("log2", 9, 10) == 3
