@@ -57,6 +57,16 @@ class TrainingData(SanityCheck):
         return np.array([p.label for p in self.labeled_points],
                         dtype=np.float32)
 
+    def encode_labels(self) -> Tuple[Tuple[float, ...], np.ndarray]:
+        """Float labels (plan ids) → (sorted class tuple, int32 class
+        indices) — the shared contract every classification algorithm's
+        model uses to map predictions back to original labels."""
+        labels = self.labels_array()
+        classes = tuple(sorted(set(labels.tolist())))
+        class_ix = {c: i for i, c in enumerate(classes)}
+        y = np.array([class_ix[l] for l in labels], dtype=np.int32)
+        return classes, y
+
 
 class DataSource(BaseDataSource):
     params_class = DataSourceParams

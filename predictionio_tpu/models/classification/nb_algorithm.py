@@ -44,10 +44,7 @@ class NaiveBayesAlgorithm(Algorithm):
         self.ap = params
 
     def train(self, ctx, data: TrainingData) -> ClassificationModel:
-        labels = data.labels_array()
-        classes = tuple(sorted(set(labels.tolist())))
-        class_ix = {c: i for i, c in enumerate(classes)}
-        y = np.array([class_ix[l] for l in labels], dtype=np.int32)
+        classes, y = data.encode_labels()
         model = naive_bayes.train(
             data.features_array(), y, lambda_=self.ap.lambda_,
             n_classes=len(classes))

@@ -177,14 +177,11 @@ class RandomForestAlgorithm(Algorithm):
 
     def train(self, ctx, data: TrainingData) -> RandomForestModel:
         x = data.features_array().astype(np.float64)
-        labels = data.labels_array()
-        classes = tuple(sorted(set(labels.tolist())))
+        classes, y = data.encode_labels()
         if len(classes) > self.ap.numClasses:
             raise ValueError(
                 f"data has {len(classes)} classes but numClasses="
                 f"{self.ap.numClasses}")
-        class_ix = {c: i for i, c in enumerate(classes)}
-        y = np.array([class_ix[l] for l in labels], dtype=np.int32)
         seed = self.ap.seed if self.ap.seed is not None else (
             np.random.SeedSequence().entropy % (2 ** 31))
         rng = np.random.default_rng(int(seed))
@@ -207,3 +204,15 @@ class RandomForestAlgorithm(Algorithm):
         x = np.asarray([query.features], dtype=np.float64)
         ix = int(self._vote(model, x)[0])
         return PredictedResult(label=model.class_labels[ix])
+
+    def batch_predict(self, model: RandomForestModel, queries):
+        """Eval path: one stacked _vote pass over all queries instead of
+        numTrees tree evaluations per query."""
+        queries = list(queries)
+        if not queries:
+            return []
+        x = np.asarray([q.features for _qx, q in queries],
+                       dtype=np.float64)
+        votes = self._vote(model, x)
+        return [(qx, PredictedResult(label=model.class_labels[int(v)]))
+                for (qx, _q), v in zip(queries, votes)]

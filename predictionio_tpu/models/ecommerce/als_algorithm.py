@@ -171,6 +171,42 @@ class ECommAlgorithm(Algorithm):
             return set()
         return set(events[0].properties.get_opt("items") or ())
 
+    def _item_weights(self, model: "ECommModel") -> Optional[np.ndarray]:
+        """Latest $set on constraint/weightedItems → per-item score
+        multipliers, default 1.0 (the weighted-items template variant,
+        weighted-items/ALSAlgorithm.scala:234-261: groups of
+        {items: [...], weight: w} so business rules can boost or bury
+        item groups without retraining)."""
+        try:
+            events = store.find_by_entity(
+                app_name=self.ap.appName, entity_type="constraint",
+                entity_id="weightedItems", event_names=["$set"],
+                limit=1, latest=True, storage=self._storage)
+        except Exception as e:
+            logger.error("Error when reading set weightedItems event: %s", e)
+            return None
+        if not events:
+            return None
+        groups = events[0].properties.get_opt("weights") or ()
+        w: Optional[np.ndarray] = None
+        for g in groups:
+            try:
+                items = g.get("items") or ()
+                weight = float(g.get("weight", 1.0))
+                if isinstance(items, str) or not hasattr(items, "__iter__"):
+                    raise TypeError(f"items must be a list, got {items!r}")
+                for item in items:
+                    ix = model.item_vocab.get(item)
+                    if ix is not None:
+                        if w is None:
+                            w = np.ones(len(model.item_vocab),
+                                        dtype=np.float32)
+                        w[ix] = weight
+            except (AttributeError, TypeError, ValueError) as e:
+                # a malformed group must not turn every query into a 500
+                logger.error("Malformed WeightsGroup %r ignored: %s", g, e)
+        return w
+
     # ------------------------------------------------------------- serving
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         """Known users score U[u] . V; unknown users fall back to
@@ -213,7 +249,13 @@ class ECommAlgorithm(Algorithm):
         # one BLAS matvec + argpartition beats a per-query device dispatch
         # everywhere except a locally-attached chip with a huge catalog
         # (measured 273 ms p50 through a tunneled device vs <1 ms host)
-        vals, idx = topk.host_masked_topk(factors, query_vec, mask, k)
+        weights = self._item_weights(model)
+        if weights is None:
+            vals, idx = topk.host_masked_topk(factors, query_vec, mask, k)
+        else:
+            scores = (np.asarray(factors) @ np.asarray(query_vec)) * weights
+            vals, idx = topk.host_topk(
+                np.where(np.asarray(mask), scores, -np.inf), k)
         inv = model.item_vocab.inverse()
         return PredictedResult(tuple(
             ItemScore(item=inv(int(ix)), score=float(s))

@@ -265,6 +265,37 @@ class TestECommerce:
         assert not ({"i1", "i3"} & {s.item for s in res.itemScores})
         assert "i5" in {s.item for s in res.itemScores}
 
+    def test_weighted_items_boost_scores(self, memory_storage, app):
+        """weighted-items variant (weighted-items/ALSAlgorithm.scala:
+        234-261): a live $set on constraint/weightedItems multiplies
+        scores per item group; buried items drop out of the top, boosted
+        ones rise, and queries without the constraint are untouched."""
+        from predictionio_tpu.models.ecommerce import Query
+        algo, model, _td = self._train(memory_storage)
+        base = algo.predict(model, Query(user="u1", num=3))
+        top = {s.item for s in base.itemScores}
+        assert top <= {"i1", "i3", "i5"}
+        # bury the odd cluster, boost i0
+        store.write([Event(
+            event="$set", entity_type="constraint",
+            entity_id="weightedItems",
+            properties=DataMap({"weights": [
+                {"items": ["i1", "i3", "i5"], "weight": 0.001},
+                {"items": ["i0"], "weight": 100.0}]}),
+            event_time=dt.datetime(2021, 1, 2, tzinfo=UTC))],
+            app, storage=memory_storage)
+        res = algo.predict(model, Query(user="u1", num=3))
+        assert res.itemScores[0].item == "i0"
+        # latest $set wins: clearing the constraint restores base ranking
+        store.write([Event(
+            event="$set", entity_type="constraint",
+            entity_id="weightedItems",
+            properties=DataMap({"weights": []}),
+            event_time=dt.datetime(2021, 1, 3, tzinfo=UTC))],
+            app, storage=memory_storage)
+        res = algo.predict(model, Query(user="u1", num=3))
+        assert {s.item for s in res.itemScores} == top
+
     def test_new_user_falls_back_to_recent_views(self, memory_storage, app):
         from predictionio_tpu.models.ecommerce import Query
         algo, model, _td = self._train(memory_storage)
@@ -313,3 +344,33 @@ class TestECommerce:
         status, body = api.handle("POST", "/queries.json", body=json.dumps(
             {"user": "fresh", "num": 2}).encode())
         assert status == 200 and len(body["itemScores"]) == 2
+
+
+def test_malformed_weights_group_does_not_break_serving(memory_storage):
+    """A garbage weightedItems constraint must degrade to unweighted
+    serving, not a per-query error (weighted-items variant hardening)."""
+    from predictionio_tpu.models.ecommerce.als_algorithm import ECommAlgorithm
+    from predictionio_tpu.models.ecommerce import ECommAlgorithmParams
+
+    class FakeVocab:
+        def get(self, k):
+            return None
+        def __len__(self):
+            return 3
+
+    algo = ECommAlgorithm(ECommAlgorithmParams(appName="nope"))
+    # _item_weights reads the store lazily; feed it groups directly
+    class M:
+        item_vocab = FakeVocab()
+    import unittest.mock as mock
+    from predictionio_tpu.data import store as st
+    ev = mock.Mock()
+    ev.properties.get_opt.return_value = [
+        {"items": 42, "weight": 2.0},          # non-iterable
+        {"items": "i1", "weight": 2.0},        # string (char iteration)
+        "not a dict",                          # wrong type entirely
+        {"items": ["i1"], "weight": "heavy"},  # non-numeric weight
+    ]
+    with mock.patch.object(st, "find_by_entity", return_value=[ev]):
+        w = algo._item_weights(M())
+    assert w is None      # every group rejected, serving stays unweighted
