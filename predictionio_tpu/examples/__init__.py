@@ -36,5 +36,8 @@ scala-stock                       stock (indicators, vmapped regression
 scala-recommendations             covered by models/recommendation
 similarproduct/recommended-user   recommended_user (from the supported
   (examples/scala-parallel-...)     template family's variant set)
+similarproduct/{filterbyyear,     similarproduct_variants (year filter,
+  no-set-user, add-rateevent,       users-from-events, explicit rate
+  add-and-return-item-properties}   signal, properties in results)
 ================================  =======================================
 """

@@ -42,6 +42,10 @@ class ECommAlgorithmParams(Params):
     numIterations: int = 20
     lambda_: float = 0.01
     seed: Optional[int] = None
+    #: weighted-items variant: live $set constraint/weightedItems boosts.
+    #: One extra event-store point read per query; disable to keep the
+    #: base template's two-lookup hot path.
+    weightedItems: bool = True
 
     JSON_ALIASES = {"lambda": "lambda_"}
 
@@ -249,13 +253,10 @@ class ECommAlgorithm(Algorithm):
         # one BLAS matvec + argpartition beats a per-query device dispatch
         # everywhere except a locally-attached chip with a huge catalog
         # (measured 273 ms p50 through a tunneled device vs <1 ms host)
-        weights = self._item_weights(model)
-        if weights is None:
-            vals, idx = topk.host_masked_topk(factors, query_vec, mask, k)
-        else:
-            scores = (np.asarray(factors) @ np.asarray(query_vec)) * weights
-            vals, idx = topk.host_topk(
-                np.where(np.asarray(mask), scores, -np.inf), k)
+        weights = self._item_weights(model) if self.ap.weightedItems \
+            else None
+        vals, idx = topk.host_masked_topk(factors, query_vec, mask, k,
+                                          weights=weights)
         inv = model.item_vocab.inverse()
         return PredictedResult(tuple(
             ItemScore(item=inv(int(ix)), score=float(s))

@@ -559,9 +559,18 @@ def solve_factors(A: jnp.ndarray, b: jnp.ndarray, reg: jnp.ndarray) -> jnp.ndarr
 
 
 def _reg_vec(counts, n_self, lambda_, reg_scaling):
-    """MLlib ALS-WR regularization: lambda * n_ratings(row) or constant."""
+    """MLlib ALS-WR regularization: lambda * n_ratings(row) or constant.
+
+    Zero-count rows get one rating's worth of lambda, not the bare _EPS:
+    1e-8 is below f32 resolution next to YtY entries, so the implicit
+    path's A = YtY + 0 + eps*I is numerically singular for a cold row and
+    the unpivoted Gauss-Jordan sweep hits an exactly-zero pivot → 0/0 →
+    one NaN row → the NEXT iteration's YtY is all-NaN and the whole model
+    is poisoned. The solve's result for a cold row is 0 either way (rhs is
+    0); the floor only makes it numerically reachable. Trained rows
+    (count >= 1) are unchanged."""
     if reg_scaling == "count":
-        return lambda_ * counts.astype(jnp.float32) + _EPS
+        return lambda_ * jnp.maximum(counts, 1).astype(jnp.float32) + _EPS
     return jnp.full((n_self,), lambda_ + _EPS, dtype=jnp.float32)
 
 
@@ -912,11 +921,8 @@ def _half_step_implicit(other, side_idx, side_other, side_rating, counts,
         other, side_idx, side_other, conf, (1.0 + conf) * pref,
         n_self, chunk)
     A = YtY[None] + A_corr
-    if reg_scaling == "count":
-        reg = lambda_ * counts.astype(jnp.float32) + _EPS
-    else:
-        reg = jnp.full((n_self,), lambda_ + _EPS, dtype=jnp.float32)
-    return solve_factors(A, b, reg)
+    return solve_factors(A, b, _reg_vec(counts, n_self, lambda_,
+                                        reg_scaling))
 
 
 @partial(jax.jit, static_argnames=(

@@ -48,13 +48,16 @@ def topk_for_user(
     return jax.lax.top_k(item_factors @ q, k)
 
 
-def host_masked_topk(factors, query_vec, mask, k: int):
+def host_masked_topk(factors, query_vec, mask, k: int, weights=None):
     """Host serving kernel shared by the item-scoring templates: one BLAS
-    matvec, -inf outside the candidate mask, argpartition top-K. Callers
-    drop non-finite/non-positive entries when building results."""
+    matvec, optional per-item score multipliers (the weighted-items
+    business rule), -inf outside the candidate mask, argpartition top-K.
+    Callers drop non-finite/non-positive entries when building results."""
     import numpy as np
 
     scores = np.asarray(factors) @ np.asarray(query_vec)
+    if weights is not None:
+        scores = scores * np.asarray(weights)
     scores = np.where(np.asarray(mask), scores, -np.inf)
     return host_topk(scores, k)
 

@@ -337,3 +337,25 @@ def test_batch_predict_clamps_nonpositive_num(memory_storage):
     res2 = dict(algo.batch_predict(model, [
         (0, Query(user="u1", num=0)), (1, Query(user="u2", num=-5))]))
     assert all(r.itemScores == () for r in res2.values())
+
+
+@pytest.mark.parametrize("kernel", ["csrb", "scan"])
+def test_implicit_cold_rows_do_not_poison_model(kernel):
+    """An item (or user) with ZERO interactions must solve to a zero row,
+    not NaN: with the bare 1e-8 ridge (invisible in f32 next to YtY) the
+    cold row's unpivoted solve produced 0/0, and one NaN row made the
+    next iteration's YtY — and the entire model — NaN."""
+    u = np.array([0, 0, 1, 1, 2], dtype=np.int32)
+    i = np.array([0, 1, 0, 1, 2], dtype=np.int32)
+    r = np.ones(5, dtype=np.float32)
+    # item 3 and user 3 exist in the vocab but have no interactions
+    data = als.prepare_ratings(u, i, r, n_users=4, n_items=4)
+    U, V = als.train_implicit(data, rank=4, iterations=10, lambda_=0.01,
+                              alpha=1.0, seed=3, kernel=kernel)
+    U, V = np.asarray(U), np.asarray(V)
+    assert np.isfinite(U).all() and np.isfinite(V).all()
+    np.testing.assert_allclose(U[3], 0.0)
+    np.testing.assert_allclose(V[3], 0.0)
+    # trained rows still reconstruct the signal
+    pred = np.sum(U[u] * V[i], axis=1)
+    assert (pred > 0).all()

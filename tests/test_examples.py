@@ -742,3 +742,121 @@ def test_example_engine_drives_through_engine_json(tmp_path, memory_storage):
     iid = run_train(ctx, engine, ep, engine_factory=variant["engineFactory"],
                     params_json=variant)
     assert memory_storage.get_model_data_models().get(iid) is not None
+
+
+class TestSimilarProductVariants:
+    """filterbyyear / no-set-user / add-rateevent /
+    add-and-return-item-properties, composed."""
+
+    @pytest.fixture()
+    def app(self, memory_storage):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App
+        app_id = memory_storage.get_meta_data_apps().insert(
+            App(0, "spvapp", None))
+        memory_storage.get_events().init(app_id)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        evs = []
+        # NO $set user events at all (no-set-user variant)
+        for i, (cats, year, title) in enumerate([
+                (["a"], 2001, "Alpha"), (["a"], 1995, "Beta"),
+                (["b"], 2010, "Gamma")]):
+            evs.append(Event(
+                event="$set", entity_type="item", entity_id=f"i{i}",
+                properties=DataMap({"categories": cats, "year": year,
+                                    "title": title, "date": f"{year}-01-01"}),
+                event_time=t0))
+        views = [("u1", "i0"), ("u1", "i1"), ("u2", "i0"), ("u2", "i1"),
+                 ("u3", "i2")]
+        for n, (u, i) in enumerate(views):
+            evs.append(Event(
+                event="view", entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+                event_time=t0 + dt.timedelta(minutes=n)))
+        store.write(evs, app_id)
+        return app_id
+
+    def train(self, memory_storage):
+        from predictionio_tpu.examples import similarproduct_variants as sv
+        engine = sv.engine()
+        ep = EngineParams(
+            data_source_params=sv.VDataSourceParams(appName="spvapp"),
+            algorithm_params_list=(
+                ("als", sv.VALSParams(rank=4, numIterations=10, seed=3)),))
+        ctx = WorkflowContext(storage=memory_storage)
+        return sv, engine.train(ctx, ep)[0]
+
+    def test_no_set_user_and_returned_properties(self, memory_storage, app):
+        sv, model = self.train(memory_storage)
+        algo = sv.VALSAlgorithm()
+        r = algo.predict(model, sv.VQuery(items=("i0",), num=3))
+        assert r.itemScores
+        top = r.itemScores[0]
+        assert top.item == "i1"                # co-viewed cluster
+        assert top.title == "Beta" and top.year == 1995   # properties ride
+        assert top.date == "1995-01-01"
+
+    def test_year_filter(self, memory_storage, app):
+        sv, model = self.train(memory_storage)
+        algo = sv.VALSAlgorithm()
+        # i1 is from 1995; filtering recommendFromYear=2000 removes it
+        r = algo.predict(model, sv.VQuery(items=("i0",), num=3,
+                                          recommendFromYear=2000))
+        assert all(s.item != "i1" for s in r.itemScores)
+        r = algo.predict(model, sv.VQuery(items=("i0",), num=3,
+                                          recommendFromYear=1990))
+        assert any(s.item == "i1" for s in r.itemScores)
+
+    def test_rate_events_switch_to_explicit_latest_wins(
+            self, memory_storage, app):
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        t0 = dt.datetime(2021, 1, 2, tzinfo=dt.timezone.utc)
+        evs = []
+        pairs = [("u1", "i0", 5.0, 0), ("u1", "i1", 5.0, 1),
+                 ("u2", "i0", 5.0, 2), ("u2", "i1", 5.0, 3),
+                 ("u3", "i2", 4.0, 4),
+                 ("u1", "i1", 1.0, 0)]     # EARLIER than the 5.0 -> loses
+        for u, i, rt, m in pairs:
+            evs.append(Event(
+                event="rate", entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=i,
+                properties=DataMap({"rating": rt}),
+                event_time=t0 + dt.timedelta(minutes=m)))
+        store.write(evs, app)
+        sv, model = self.train(memory_storage)
+        algo = sv.VALSAlgorithm()
+        r = algo.predict(model, sv.VQuery(items=("i0",), num=3))
+        assert r.itemScores and r.itemScores[0].item == "i1"
+
+    def test_negative_year_floor_excludes_yearless_items(
+            self, memory_storage, app):
+        """recommendFromYear=-1 must not resurrect items without a year
+        property (the 0 sentinel is excluded explicitly)."""
+        import datetime as dt
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        # i3: viewed by the i0 cluster's users, but NO year property
+        t0 = dt.datetime(2021, 1, 1, 12, tzinfo=dt.timezone.utc)
+        evs = [Event(event="$set", entity_type="item", entity_id="i3",
+                     properties=DataMap({"categories": ["a"],
+                                         "title": "NoYear"}),
+                     event_time=t0)]
+        for u in ("u1", "u2"):
+            evs.append(Event(event="view", entity_type="user", entity_id=u,
+                             target_entity_type="item",
+                             target_entity_id="i3", event_time=t0))
+        store.write(evs, app)
+        sv, model = self.train(memory_storage)
+        algo = sv.VALSAlgorithm()
+        r = algo.predict(model, sv.VQuery(items=("i0",), num=5))
+        assert any(s.item == "i3" for s in r.itemScores)   # unfiltered: in
+        r = algo.predict(model, sv.VQuery(items=("i0",), num=5,
+                                          recommendFromYear=-1))
+        assert all(s.item != "i3" for s in r.itemScores)   # filtered: out

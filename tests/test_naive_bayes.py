@@ -109,3 +109,19 @@ class TestRandomForest:
         assert _n_features_per_split("auto", 9, 1) == 9      # MLlib rule
         assert _n_features_per_split("auto", 9, 10) == 3
         assert _n_features_per_split("log2", 9, 10) == 3
+
+    def test_batch_predict_matches_predict(self):
+        from predictionio_tpu.models.classification.engine import Query
+        from predictionio_tpu.models.classification.random_forest import (
+            RandomForestAlgorithm, RandomForestAlgorithmParams)
+        x, y = self.xor_data(n=200, seed=4)
+        td = self.make_td(x, y)
+        algo = RandomForestAlgorithm(RandomForestAlgorithmParams(
+            numClasses=2, numTrees=7, maxDepth=5, seed=9))
+        model = algo.train(None, td)
+        xt, _ = self.xor_data(n=40, seed=5)
+        queries = [(qi, Query(tuple(row))) for qi, row in enumerate(xt)]
+        batch = dict(algo.batch_predict(model, queries))
+        for qi, q in queries:
+            assert batch[qi].label == algo.predict(model, q).label
+        assert algo.batch_predict(model, []) == []
