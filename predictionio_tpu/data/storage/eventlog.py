@@ -729,6 +729,11 @@ class EventlogEvents(Events):
                     out.append(e.target_entity_id)
             ent_code = (sh.codes.get(entity_id, -2)
                         if entity_id is not None else None)
+            if ent_code == -2:
+                # the shard dictionary never coded this id, so no FLUSHED
+                # event can reference it — skip every chunk probe (a point
+                # read of an absent entity is O(buffer), not O(chunks))
+                return out
             ev_codes = None
             if event_names is not None:
                 ev_codes = [sh.codes[nm] for nm in event_names
@@ -918,8 +923,16 @@ class EventlogEvents(Events):
 
             # chunk visit order enables pruning: ascending by tmin (or
             # descending by tmax when reversed_); un-indexed legacy chunks
-            # sort first so a later break never skips one
-            chunks = [(seq, sh.chunk_index(seq)) for seq in sh.chunk_seqs()]
+            # sort first so a later break never skips one. A point filter
+            # on an id the shard dictionary NEVER coded (-2) cannot match
+            # any flushed event — skip all chunk probes outright (the
+            # absent-constraint lookup the e-commerce template issues per
+            # query must be O(buffer), not O(chunks))
+            if ent_code == -2 or tgt_code == -2:
+                chunks = []
+            else:
+                chunks = [(seq, sh.chunk_index(seq))
+                          for seq in sh.chunk_seqs()]
             if reversed_:
                 chunks.sort(key=lambda si: (
                     -int(si[1]["tmax"]) if si[1] is not None else -(1 << 62)))

@@ -168,7 +168,8 @@ class VModel:
     items: Dict[int, VItem]
     trained: np.ndarray           # (n_items,) bool
     category_masks: Dict[str, np.ndarray] = None
-    years: np.ndarray = None      # (n_items,) int32, 0 = no year property
+    years: np.ndarray = None      # (n_items,) int32 (valid where has_year)
+    has_year: np.ndarray = None   # (n_items,) bool
 
 
 class VALSAlgorithm(Algorithm):
@@ -222,14 +223,16 @@ class VALSAlgorithm(Algorithm):
         V = V / np.where(norms > 0, norms, 1.0)[:, None]
         items = {item_vocab(iid): item for iid, item in data.items.items()}
         years = np.zeros(len(item_vocab), dtype=np.int32)
+        has_year = np.zeros(len(item_vocab), dtype=bool)
         for ix, item in items.items():
             if item.year is not None:
                 years[ix] = item.year
+                has_year[ix] = True
         return VModel(item_factors=V, item_vocab=item_vocab, items=items,
                       trained=trained,
                       category_masks=build_category_masks(
                           items, len(item_vocab)),
-                      years=years)
+                      years=years, has_year=has_year)
 
     def predict(self, model: VModel, query: VQuery) -> VPredictedResult:
         vocab = model.item_vocab
@@ -256,9 +259,9 @@ class VALSAlgorithm(Algorithm):
         if query.recommendFromYear is not None:
             # year > recommendFromYear (filterbyyear ALSAlgorithm.scala:248;
             # its Item.year is mandatory — here an item WITHOUT a year
-            # fails any year-filtered query, including a negative floor,
-            # so the 0 sentinel is excluded explicitly)
-            mask &= (model.years != 0) & \
+            # fails any year-filtered query, tracked by a boolean so a
+            # literal year=0 property is not mistaken for "no year")
+            mask &= model.has_year & \
                 (model.years > query.recommendFromYear)
 
         vals, idx = host_topk(np.where(mask & (scores > 0), scores,

@@ -448,3 +448,52 @@ def test_find_target_ids_fast_path_matches_generic(tmp_path):
         "app", "user", "u3", event_names=["view"],
         target_entity_type="item", storage=s))
     assert fast == generic
+
+
+def test_absent_entity_point_read_skips_all_chunks(tmp_path):
+    """A find/find_target_ids on an id the dictionary never coded must not
+    probe ANY chunk index (the per-query absent-constraint lookup at 20M
+    events measured 14 ms p50 when it walked every chunk's postings)."""
+    from unittest import mock
+
+    storage, app_id = make_storage(tmp_path, "eventlog")
+    ev = storage.get_events()
+    t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+    for c in range(5):         # one flush per batch -> 5 chunks
+        for k in range(20):
+            n = c * 20 + k
+            ev.insert(Event(event="view", entity_type="user",
+                            entity_id=f"u{n % 5}",
+                            target_entity_type="item",
+                            target_entity_id=f"i{n % 7}",
+                            event_time=t0 + dt.timedelta(seconds=n)),
+                      app_id)
+        ev.flush(app_id)
+    sh = ev._shard(app_id, None)
+    assert len(list(sh.chunk_seqs())) >= 3
+
+    with mock.patch.object(type(sh), "chunk_index",
+                           side_effect=AssertionError("chunk probed")) \
+            as spy:
+        assert list(ev.find(app_id=app_id, entity_type="constraint",
+                            entity_id="weightedItems")) == []
+        assert ev.find_target_ids(
+            app_id=app_id, entity_type="constraint",
+            entity_id="weightedItems") == []
+        # absent TARGET id too
+        assert list(ev.find(app_id=app_id,
+                            target_entity_id="ghost-item")) == []
+    # present ids still resolve (and DO probe chunks)
+    got = ev.find_target_ids(app_id=app_id, entity_type="user",
+                             entity_id="u1", event_names=["view"],
+                             target_entity_type="item")
+    assert got                        # u1 has views
+    # an id that exists ONLY in the unflushed buffer is still found
+    ev.insert(Event(event="$set", entity_type="constraint",
+                    entity_id="brandNewConstraint",
+                    properties=DataMap({"x": 1}),
+                    event_time=t0 + dt.timedelta(hours=1)), app_id)
+    found = list(ev.find(app_id=app_id, entity_type="constraint",
+                         entity_id="brandNewConstraint"))
+    assert len(found) == 1
+    ev.close()
