@@ -316,8 +316,9 @@ def gram_rhs_csrb(
     r = other_factors.shape[1]
     X = _expand_X(other_factors, r, jnp.float32)
     AB = _gram_rhs_csrb_flat(X, other_idx, coeff_a, coeff_b, mb_seg,
-                             n_self, b, chunk)
-    return AB[:, :r * r].reshape(n_self, r, r), AB[:, r * r:]
+                             n_self, b, chunk, r)
+    return (AB[:, :r * r].reshape(n_self, r, r),
+            AB[:, r * r:r * r + r])
 
 
 # ---------------------------------------------------------------------------
@@ -460,13 +461,17 @@ def _hybrid_prepare(data: ALSData, K: int, implicit: bool, alpha: float,
                       u_chunk=u_chunk, i_chunk=i_chunk, K=K)
 
 
-def _gram_col_mask(r: int):
+def _gram_col_mask(r: int, wp: Optional[int] = None):
     # select gram columns from the a-product and rhs columns from the
     # b-product via mask-add: concatenating offset SLICES miscompiles on
     # the axon backend (measured wrong values on a plain input array), so
-    # only row slices + elementwise ops are used here
+    # only row slices + elementwise ops are used here. `wp` >= r²+r covers
+    # 512B-padded X rows; the pad region is harmless under (1-mask)
+    # because padded X columns are zero.
+    if wp is None:
+        wp = r * r + r
     return jnp.concatenate([jnp.ones((r * r,), jnp.float32),
-                            jnp.zeros((r,), jnp.float32)])
+                            jnp.zeros((wp - r * r,), jnp.float32)])
 
 
 def _dense_hot_user(D, X_hot, K: int, r: int):
@@ -479,7 +484,7 @@ def _dense_hot_user(D, X_hot, K: int, r: int):
         D[:, K:], X_hot, (((1,), (0,)), ((), ())),
         precision=lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32)
-    m = _gram_col_mask(r)
+    m = _gram_col_mask(r, X_hot.shape[1])
     return g * m + h * (1.0 - m)
 
 
@@ -488,18 +493,40 @@ def _dense_hot_item(D, Z, K: int, r: int):
     out = jax.lax.dot_general(
         D, Z, (((0,), (0,)), ((), ())),
         precision=lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)      # (2K, r²+r)
-    m = _gram_col_mask(r)
+        preferred_element_type=jnp.float32)      # (2K, wp)
+    m = _gram_col_mask(r, Z.shape[1])
     return out[:K] * m + out[K:] * (1.0 - m)
 
 
+def _xpad_enabled() -> bool:
+    import os
+    return os.environ.get("PIO_ALS_XPAD", "1") != "0"
+
+
+def _xpad_width(w: int) -> int:
+    """Pad the expanded-X row width to a 512-byte (128-float) multiple so
+    every tail gather reads whole aligned HBM transactions: a 440-byte
+    (r=10) row at arbitrary stride straddles two 512B transactions (~43%
+    useful bandwidth); padded+aligned it is exactly one (86%)."""
+    if not _xpad_enabled():
+        return w
+    return -(-w // 128) * 128
+
+
 def _expand_X(factors, r: int, dtype):
-    return jnp.concatenate(
+    w = r * r + r
+    out = jnp.concatenate(
         [(factors[:, :, None] * factors[:, None, :]).reshape(-1, r * r),
          factors], axis=1).astype(dtype)
+    wp = _xpad_width(w)
+    if wp != w:
+        out = jnp.concatenate(
+            [out, jnp.zeros((out.shape[0], wp - w), dtype)], axis=1)
+    return out
 
 
-def _gram_tail(other_factors_X, tail, n_self, b, chunk, implicit, alpha):
+def _gram_tail(other_factors_X, tail, n_self, b, chunk, implicit, alpha,
+               r):
     oi, rat, pres, seg = tail
     if implicit:
         conf = alpha * jnp.abs(rat)
@@ -507,18 +534,21 @@ def _gram_tail(other_factors_X, tail, n_self, b, chunk, implicit, alpha):
     else:
         ca, cb = pres, rat
     return _gram_rhs_csrb_flat(other_factors_X, oi, ca, cb, seg,
-                               n_self, b, chunk)
+                               n_self, b, chunk, r)
 
 
 def _gram_rhs_csrb_flat(X, other_idx, coeff_a, coeff_b, mb_seg,
-                        n_self: int, b: int, chunk: int) -> jnp.ndarray:
-    """gram_rhs_csrb but taking a prebuilt X and returning flat (n, r²+r)
-    so hybrid can sum dense + tail before splitting into A and rhs."""
+                        n_self: int, b: int, chunk: int,
+                        r: int) -> jnp.ndarray:
+    """gram_rhs_csrb but taking a prebuilt (possibly 512B-row-padded) X
+    and returning flat (n, X.shape[1]) so hybrid can sum dense + tail
+    before slicing into A and rhs. Pad columns of X are zero, so the
+    rhs-side (1-mask) weighting contributes nothing there."""
     w = X.shape[1]
     n_mb = mb_seg.shape[0]
     m = max(chunk // b, 1)
     n_chunks = max(n_mb // m, 1)
-    r2 = w - int((np.sqrt(4 * w + 1) - 1) / 2)  # w = r² + r
+    r2 = r * r
     mask_a = jnp.concatenate([jnp.ones((r2,), jnp.float32),
                               jnp.zeros((w - r2,), jnp.float32)])
 
@@ -721,25 +751,25 @@ def _train_hybrid_jit(
     def one_iter(_, UV):
         U, V = UV
         # ---- user half-step: dense hot items + csrb cold tail
-        X = _expand_X(V, r, jnp.float32)                 # (n_items, r²+r)
+        X = _expand_X(V, r, jnp.float32)        # (n_items, wp >= r²+r)
         X_hot = jnp.take(X, hot_ids, axis=0).astype(_HYBRID_DTYPE)
         AB = _dense_hot_user(D, X_hot, K, r)
         AB = AB + _gram_tail(X, (u_oi, u_rat, u_pres, u_seg),
-                             n_users, b, u_chunk, implicit, alpha)
+                             n_users, b, u_chunk, implicit, alpha, r)
         A = AB[:, : r * r].reshape(n_users, r, r)
         if implicit:
             A = A + (V.T @ V)[None]
-        U = solve_factors(A, AB[:, r * r:], u_reg)
+        U = solve_factors(A, AB[:, r * r:r * r + r], u_reg)
         # ---- item half-step: same D transposed + csrb cold tail
-        Z = _expand_X(U, r, jnp.float32)                 # (n_users, r²+r)
+        Z = _expand_X(U, r, jnp.float32)        # (n_users, wp)
         AB_hot = _dense_hot_item(D, Z.astype(_HYBRID_DTYPE), K, r)
         ABi = _gram_tail(Z, (i_oi, i_rat, i_pres, i_seg),
-                         n_items, b, i_chunk, implicit, alpha)
+                         n_items, b, i_chunk, implicit, alpha, r)
         ABi = ABi.at[hot_ids].add(AB_hot)
         Ai = ABi[:, : r * r].reshape(n_items, r, r)
         if implicit:
             Ai = Ai + (U.T @ U)[None]
-        V = solve_factors(Ai, ABi[:, r * r:], i_reg)
+        V = solve_factors(Ai, ABi[:, r * r:r * r + r], i_reg)
         return (U, V)
 
     return lax.fori_loop(0, iterations, one_iter, (U0, V0))

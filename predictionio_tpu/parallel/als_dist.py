@@ -503,11 +503,11 @@ def _train_sharded_hybrid(
             X_hot = jnp.take(X, hot_addr, axis=0).astype(_HYBRID_DTYPE)
             AB = _dense_hot_user(D_blk, X_hot, K, r)
             AB = AB + _gram_tail(X, u_lay, su.rows_dev, b, u_chunk,
-                                 implicit, alpha)
+                                 implicit, alpha, r)
             A = AB[:, : r * r].reshape(su.rows_dev, r, r)
             if implicit:
                 A = A + (V.T @ V)[None]
-            U_blk = solve_factors(A, AB[:, r * r:], u_reg)
+            U_blk = solve_factors(A, AB[:, r * r:r * r + r], u_reg)
             U = lax.all_gather(U_blk, axis, tiled=True)
             # ---- item half-step: dense partials psum over devices
             Z_local = _expand_X(U_blk, r, jnp.float32)
@@ -516,12 +516,12 @@ def _train_sharded_hybrid(
             AB_hot = lax.psum(AB_hot, axis)           # (K, w) full
             Z = _expand_X(U, r, jnp.float32)
             ABi = _gram_tail(Z, i_lay, si.rows_dev, b, i_chunk,
-                             implicit, alpha)
+                             implicit, alpha, r)
             ABi = ABi.at[local_hot].add(AB_hot, mode="drop")
             Ai = ABi[:, : r * r].reshape(si.rows_dev, r, r)
             if implicit:
                 Ai = Ai + (U.T @ U)[None]
-            V_blk = solve_factors(Ai, ABi[:, r * r:], i_reg)
+            V_blk = solve_factors(Ai, ABi[:, r * r:r * r + r], i_reg)
             V = lax.all_gather(V_blk, axis, tiled=True)
             return (U, V)
 
