@@ -197,6 +197,16 @@ def prepare_ratings(
 #       and as the reference implementation for parity tests.
 
 
+def _tuning_key() -> tuple:
+    """Env-tunable kernel knobs that are READ AT TRACE TIME deep inside the
+    jitted trainers (PIO_ALS_XPAD in _expand_X, PIO_ALS_SOLVER in
+    solve_factors). Passed to every module-level jitted trainer as a static
+    arg so flipping a knob re-traces instead of silently reusing the
+    cached executable compiled under the old value."""
+    from predictionio_tpu.ops.solve_pallas import solver_choice
+    return (_xpad_enabled(), solver_choice())
+
+
 def _kernel_flag(kernel: Optional[str]) -> str:
     import os
     k = kernel or os.environ.get("PIO_ALS_KERNEL", "hybrid")
@@ -461,15 +471,13 @@ def _hybrid_prepare(data: ALSData, K: int, implicit: bool, alpha: float,
                       u_chunk=u_chunk, i_chunk=i_chunk, K=K)
 
 
-def _gram_col_mask(r: int, wp: Optional[int] = None):
+def _gram_col_mask(r: int, wp: int):
     # select gram columns from the a-product and rhs columns from the
     # b-product via mask-add: concatenating offset SLICES miscompiles on
     # the axon backend (measured wrong values on a plain input array), so
     # only row slices + elementwise ops are used here. `wp` >= r²+r covers
     # 512B-padded X rows; the pad region is harmless under (1-mask)
     # because padded X columns are zero.
-    if wp is None:
-        wp = r * r + r
     return jnp.concatenate([jnp.ones((r * r,), jnp.float32),
                             jnp.zeros((wp - r * r,), jnp.float32)])
 
@@ -577,6 +585,14 @@ def solve_factors(A: jnp.ndarray, b: jnp.ndarray, reg: jnp.ndarray) -> jnp.ndarr
     measured 377 ms vs 8.6 ms for this sweep at (138k, 10, 10) on a v5e.
     """
     r = A.shape[-1]
+    if r <= 32:
+        from predictionio_tpu.ops.solve_pallas import (solve_factors_pallas,
+                                                       solver_choice)
+        if solver_choice() == "pallas":
+            # all sweeps in VMEM: one tile read + solution write per block
+            # (measured 8.2 -> 4.4 ms at the bench's 138k x 10 shape; the
+            # XLA sweep materializes every elimination step to HBM)
+            return solve_factors_pallas(A, b, reg)
     A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)[None]
     if r > 32:
         return jnp.linalg.solve(A, b[..., None])[..., 0]
@@ -681,7 +697,7 @@ def _csrb_side(side: COOSide, b: int, chunk: int, nnz: int):
 
 @partial(jax.jit, static_argnames=(
     "n_users", "n_items", "b", "u_chunk", "i_chunk", "reg_scaling",
-    "implicit"))
+    "implicit", "tuning"))
 def _train_csrb_jit(
     u_oi, u_rat, u_pres, u_seg, u_counts,
     i_oi, i_rat, i_pres, i_seg, i_counts,
@@ -689,7 +705,7 @@ def _train_csrb_jit(
     iterations, lambda_: float, alpha: float,
     n_users: int, n_items: int, b: int, u_chunk: int, i_chunk: int,
     reg_scaling: str, implicit: bool,
-):
+    tuning: tuple = ()):
     # iterations is traced: one compiled program serves any count
     def one_iter(_, UV):
         U, V = UV
@@ -729,7 +745,8 @@ def _run_csrb(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
             u, v, iterations=n_iters, lambda_=float(lambda_),
             alpha=float(alpha), n_users=data.n_users, n_items=data.n_items,
             b=b, u_chunk=u_chunk, i_chunk=i_chunk,
-            reg_scaling=reg_scaling, implicit=implicit)
+            reg_scaling=reg_scaling, implicit=implicit,
+            tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)
@@ -737,13 +754,13 @@ def _run_csrb(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
 
 @partial(jax.jit, static_argnames=(
     "n_users", "n_items", "K", "b", "u_chunk", "i_chunk", "reg_scaling",
-    "implicit"))
+    "implicit", "tuning"))
 def _train_hybrid_jit(
     D, hot_ids, u_oi, u_rat, u_pres, u_seg, i_oi, i_rat, i_pres, i_seg,
     u_counts, i_counts, U0, V0, iterations, lambda_: float, alpha: float,
     n_users: int, n_items: int, K: int, b: int, u_chunk: int, i_chunk: int,
     reg_scaling: str, implicit: bool,
-):
+    tuning: tuple = ()):
     r = U0.shape[1]
     u_reg = _reg_vec(u_counts, n_users, lambda_, reg_scaling)
     i_reg = _reg_vec(i_counts, n_items, lambda_, reg_scaling)
@@ -799,7 +816,8 @@ def _run_hybrid(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
             lambda_=float(lambda_), alpha=float(alpha),
             n_users=data.n_users, n_items=data.n_items, K=hy.K, b=b,
             u_chunk=hy.u_chunk, i_chunk=hy.i_chunk,
-            reg_scaling=reg_scaling, implicit=implicit)
+            reg_scaling=reg_scaling, implicit=implicit,
+            tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)
@@ -812,14 +830,14 @@ def init_factors(key, n: int, rank: int) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=(
-    "n_users", "n_items", "chunk", "reg_scaling"))
+    "n_users", "n_items", "chunk", "reg_scaling", "tuning"))
 def _train_explicit_jit(
     u_self, u_other, u_rating, u_counts,
     i_self, i_other, i_rating, i_counts,
     U0, V0,
     iterations, lambda_: float,
     n_users: int, n_items: int, chunk: int, reg_scaling: str,
-):
+    tuning: tuple = ()):
     # iterations is traced: one compiled program serves any count (the
     # fori_loop lowers to while), so warm-up and segment runs share it
     def one_iter(_, UV):
@@ -926,7 +944,8 @@ def train_explicit(
             bi.self_idx, bi.other_idx, bi.rating, bi.counts,
             u, v, iterations=n_iters, lambda_=float(lambda_),
             n_users=data.n_users, n_items=data.n_items,
-            chunk=chunk, reg_scaling=reg_scaling)
+            chunk=chunk, reg_scaling=reg_scaling,
+            tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)
@@ -956,14 +975,14 @@ def _half_step_implicit(other, side_idx, side_other, side_rating, counts,
 
 
 @partial(jax.jit, static_argnames=(
-    "n_users", "n_items", "chunk", "reg_scaling"))
+    "n_users", "n_items", "chunk", "reg_scaling", "tuning"))
 def _train_implicit_jit(
     u_self, u_other, u_rating, u_counts,
     i_self, i_other, i_rating, i_counts,
     U0, V0,
     iterations, lambda_: float, alpha: float,
     n_users: int, n_items: int, chunk: int, reg_scaling: str,
-):
+    tuning: tuple = ()):
     def one_iter(_, UV):
         U, V = UV
         U = _half_step_implicit(V, u_self, u_other, u_rating, u_counts,
@@ -1016,7 +1035,8 @@ def train_implicit(
             bi.self_idx, bi.other_idx, bi.rating, bi.counts,
             u, v, iterations=n_iters, lambda_=float(lambda_),
             alpha=float(alpha), n_users=data.n_users, n_items=data.n_items,
-            chunk=chunk, reg_scaling=reg_scaling)
+            chunk=chunk, reg_scaling=reg_scaling,
+            tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)

@@ -359,3 +359,74 @@ def test_implicit_cold_rows_do_not_poison_model(kernel):
     # trained rows still reconstruct the signal
     pred = np.sum(U[u] * V[i], axis=1)
     assert (pred > 0).all()
+
+
+class TestPallasSolver:
+    """ops/solve_pallas.py: the VMEM Gauss-Jordan batch solver."""
+
+    @staticmethod
+    def systems(n=700, r=10, seed=0):
+        rng = np.random.default_rng(seed)
+        F = rng.normal(size=(n, r, 3)).astype(np.float32)
+        A = np.einsum("nri,nsi->nrs", F, F)     # PSD, rank 3 < r
+        b = rng.normal(size=(n, r)).astype(np.float32)
+        reg = rng.uniform(0.05, 0.5, n).astype(np.float32)
+        return A, b, reg
+
+    def test_matches_xla_gj_interpret(self, monkeypatch):
+        """Interpret mode (runs everywhere) must agree with solve_factors
+        bit-for-bit at an awkward (non-BN-multiple) batch size."""
+        import jax.numpy as jnp
+        from predictionio_tpu.ops.solve_pallas import solve_factors_pallas
+        monkeypatch.setenv("PIO_ALS_SOLVER", "gj")   # reference path
+        A, b, reg = self.systems()
+        x_ref = np.asarray(als.solve_factors(
+            jnp.asarray(A), jnp.asarray(b), jnp.asarray(reg)))
+        x = np.asarray(solve_factors_pallas(
+            jnp.asarray(A), jnp.asarray(b), jnp.asarray(reg),
+            interpret=True))
+        # rank-deficient PSD + small ridge is deliberately marginal, so
+        # compare by residual (the solver contract), plus a loose direct
+        # comparison
+        np.testing.assert_allclose(x, x_ref, rtol=5e-2, atol=5e-3)
+        r = A.shape[-1]
+        Ar = A + reg[:, None, None] * np.eye(r, dtype=np.float32)[None]
+        resid = np.einsum("nrs,ns->nr", Ar, x) - b
+        ref_resid = np.einsum("nrs,ns->nr", Ar, x_ref) - b
+        assert np.abs(resid).max() < max(2 * np.abs(ref_resid).max(), 1e-3)
+
+    def test_solver_choice_env_and_platform(self, monkeypatch):
+        from predictionio_tpu.ops import solve_pallas as sp
+        monkeypatch.setenv("PIO_ALS_SOLVER", "gj")
+        assert sp.solver_choice() == "gj"
+        monkeypatch.setenv("PIO_ALS_SOLVER", "pallas")
+        # off-TPU the opt-in downgrades (with a warning) instead of
+        # failing to lower; on a real TPU backend it engages
+        import jax
+        expected = "pallas" if jax.default_backend() == "tpu" else "gj"
+        assert sp.solver_choice() == expected
+        monkeypatch.delenv("PIO_ALS_SOLVER")
+        # default is gj: the pallas solver measured end-to-end neutral
+        # (it overlaps other work in the fused loop), so it is opt-in
+        assert sp.solver_choice() == "gj"
+
+    def test_env_flip_retraces_cached_trainer(self, monkeypatch):
+        """Flipping PIO_ALS_XPAD between same-shape trains must change the
+        compiled program (the knobs are trace-time env reads; the tuning
+        static arg makes them part of the jit cache key)."""
+        monkeypatch.setenv("PIO_ALS_XPAD", "1")
+        u = np.array([0, 0, 1, 2], dtype=np.int32)
+        i = np.array([0, 1, 1, 0], dtype=np.int32)
+        r = np.ones(4, dtype=np.float32)
+        data = als.prepare_ratings(u, i, r, 3, 2, chunk=32)
+        U1, V1 = als.train_explicit(data, rank=2, iterations=2,
+                                    lambda_=0.1, seed=1, chunk=32,
+                                    kernel="csrb")
+        n_compiled = als._train_csrb_jit._cache_size()
+        monkeypatch.setenv("PIO_ALS_XPAD", "0")
+        U2, V2 = als.train_explicit(data, rank=2, iterations=2,
+                                    lambda_=0.1, seed=1, chunk=32,
+                                    kernel="csrb")
+        assert als._train_csrb_jit._cache_size() == n_compiled + 1
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U2),
+                                   rtol=1e-5, atol=1e-6)
