@@ -40,7 +40,16 @@ class _Handler(BaseHTTPRequestHandler):
             data = payload.encode("utf-8")
             ctype = "text/html; charset=UTF-8"
         else:
-            data = json.dumps(payload).encode("utf-8")
+            try:
+                # strict JSON: a bare NaN/Infinity token is not JSON and
+                # breaks real clients; a payload carrying one is a server
+                # bug (e.g. a poisoned model's scores), not data
+                data = json.dumps(payload, allow_nan=False).encode("utf-8")
+            except ValueError:
+                status = 500
+                data = json.dumps(
+                    {"message": "response contains non-finite numbers"}
+                ).encode("utf-8")
             ctype = "application/json; charset=UTF-8"
         self.send_response(status)
         self.send_header("Content-Type", ctype)

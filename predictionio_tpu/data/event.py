@@ -29,6 +29,20 @@ def _truncate_ms(t: _dt.datetime) -> _dt.datetime:
     return t.replace(microsecond=(t.microsecond // 1000) * 1000)
 
 
+def tree_has_non_finite(obj) -> bool:
+    """True if any float in a JSON-ready tree is NaN/Inf — shared by the
+    ingest gate (below) and the serving gate (workflow/create_server.py):
+    both sides of the strict-JSON transport reject the same values."""
+    import math
+    if isinstance(obj, float):
+        return not math.isfinite(obj)
+    if isinstance(obj, dict):
+        return any(tree_has_non_finite(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(tree_has_non_finite(v) for v in obj)
+    return False
+
+
 def parse_event_time(value: Optional[str]) -> _dt.datetime:
     """Parse an ISO-8601 timestamp; naive times are taken as UTC."""
     if value is None:
@@ -120,6 +134,13 @@ class Event:
         props = d.get("properties") or {}
         if not isinstance(props, dict):
             raise ValueError("field properties must be an object")
+        if validate and tree_has_non_finite(props):
+            # python's json.loads accepts bare NaN/Infinity tokens, but the
+            # read side emits STRICT JSON (data/api/http.py) — accepting a
+            # non-finite property here would make every later read or
+            # export of that event a permanent 500
+            raise ValueError(
+                "properties must not contain NaN or Infinity values")
         tags = d.get("tags") or []
         if not isinstance(tags, list):
             raise ValueError("field tags must be an array")

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import logging
+import os
 import traceback
 from typing import Optional, Sequence
 
@@ -106,7 +107,9 @@ def run_train(
         with ctx.phase("persist"):
             models = engine.make_serializable_models(
                 ctx, instance_id, engine_params, models)
-            blob = model_io.serialize_models(models)
+            blob = model_io.serialize_models(
+                models,
+                check_finite=os.environ.get("PIO_FINITE_CHECK", "1") != "0")
             storage.get_model_data_models().insert(
                 Model(id=instance_id, models=blob))
         phases = dict(ctx.phase_seconds)

@@ -30,7 +30,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
-from predictionio_tpu.data.event import format_event_time, utcnow
+from predictionio_tpu.data.event import (
+    format_event_time, tree_has_non_finite, utcnow,
+)
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.workflow import json_extractor, model_io
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -267,6 +269,19 @@ class QueryAPI:
             result = blocker.process(
                 instance, json_extractor.to_json_obj(query), result,
                 self.plugin_context)
+
+        if tree_has_non_finite(result):
+            # the reference contract is real scores (quickstart_test.py:
+            # 95-100); json.dumps would otherwise emit bare NaN tokens —
+            # invalid JSON — straight to clients. Checked AFTER feedback/
+            # blockers so the final payload is what's validated; a cheap
+            # float walk, not a second serialization, on the latency path.
+            logger.error("prediction for instance %s contains non-finite "
+                         "scores; refusing to serve it", instance.id)
+            return 500, {"message":
+                         "prediction contains non-finite scores (the "
+                         "deployed model is numerically invalid); retrain "
+                         "or /reload a healthy instance"}
 
         dt = time.perf_counter() - t0
         with self._lock:  # ThreadingHTTPServer: concurrent queries

@@ -54,8 +54,51 @@ def to_host(obj: Any) -> Any:
                        lambda x: np.asarray(x))
 
 
-def serialize_models(models: List[Any]) -> bytes:
-    return pickle.dumps(to_host(models), protocol=pickle.HIGHEST_PROTOCOL)
+class NonFiniteModelError(ValueError):
+    """A trained model array contains NaN/Inf.
+
+    Raised by serialize_models(check_finite=True) so run_train refuses to
+    mark the EngineInstance COMPLETED (the reference's status ledger exists
+    precisely so deploy never serves a bad instance — CoreWorkflow.scala:
+    84-88, commands/Engine.scala:224-239; a poisoned blob would pass both
+    and serve garbage scores)."""
+
+
+def non_finite_report(obj: Any, limit: int = 8) -> List[str]:
+    """Describe every float array in a host-side model tree that contains
+    non-finite values. Empty list == clean. Walks the same structure
+    serialization walks, so anything persisted is covered."""
+    bad: List[str] = []
+
+    def check(x):
+        if len(bad) < limit:
+            n_nan = int(np.isnan(x).sum())
+            n_inf = int(np.isinf(x).sum())
+            if n_nan or n_inf:
+                bad.append(f"array shape={x.shape} dtype={x.dtype}: "
+                           f"{n_nan} NaN, {n_inf} Inf")
+        return x
+
+    _map_arrays(
+        obj,
+        lambda x: isinstance(x, np.ndarray)
+        and np.issubdtype(x.dtype, np.floating),
+        check)
+    return bad
+
+
+def serialize_models(models: List[Any], check_finite: bool = False) -> bytes:
+    host = to_host(models)
+    if check_finite:
+        bad = non_finite_report(host)
+        if bad:
+            raise NonFiniteModelError(
+                "trained model contains non-finite values — refusing to "
+                "persist it as COMPLETED (deploy would serve garbage "
+                "scores): " + "; ".join(bad) + ". If this model family "
+                "legitimately stores ±Inf (e.g. log-space probabilities "
+                "with zero smoothing), set PIO_FINITE_CHECK=0.")
+    return pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
 
 
 def deserialize_models(blob: bytes) -> List[Any]:
