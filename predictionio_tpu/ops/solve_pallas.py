@@ -36,13 +36,19 @@ _WARNED_OFF_TPU = False
 
 
 def _gj_kernel(m_ref, out_ref, *, r: int):
-    M = m_ref[:]                         # (r*(r+1), BN) f32 in VMEM
+    M = m_ref[:]                         # (r*(r+1)+1, BN) f32 in VMEM
     w = r + 1
     rows = [M[i] for i in range(r * w)]  # unrolled: each (BN,) vector
+    floor = M[r * w]                     # per-system pivot floor (0.5*reg)
     for k in range(r):
         # true division (not reciprocal-multiply) keeps parity with the
-        # XLA sweep tight even on marginally-conditioned systems
-        piv = [rows[k * w + j] / rows[k * w + k] for j in range(w)]
+        # XLA sweep tight even on marginally-conditioned systems; the
+        # sign-preserving magnitude floor mirrors solve_factors (inert for
+        # true SPD + ridge, a hard bound when kernel rounding broke PSD)
+        d0 = rows[k * w + k]
+        den = jnp.where(d0 >= 0, jnp.maximum(d0, floor),
+                        jnp.minimum(d0, -floor))
+        piv = [rows[k * w + j] / den for j in range(w)]
         for i in range(r):
             if i == k:
                 continue
@@ -60,6 +66,14 @@ def solve_factors_pallas(A: jnp.ndarray, b: jnp.ndarray, reg: jnp.ndarray,
     from jax.experimental import pallas as pl
 
     n, r = b.shape
+    if r > 32:
+        # the kernel fully unrolls O(r^3) vector ops and allocates
+        # (r*(r+1), _BN) VMEM tiles; past r=32 that's pathological compile
+        # time / VMEM exhaustion, not a slow solve. solve_factors guards
+        # this; direct callers get a clear error instead.
+        raise ValueError(
+            f"solve_factors_pallas supports r <= 32 (got r={r}); use "
+            "jnp.linalg.solve or ops.als.solve_factors for larger ranks")
     w = r + 1
     A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)[None]
     M = jnp.concatenate([A, b[..., None]], axis=2)    # (n, r, w)
@@ -72,11 +86,14 @@ def solve_factors_pallas(A: jnp.ndarray, b: jnp.ndarray, reg: jnp.ndarray,
         M = jnp.concatenate(
             [M, jnp.broadcast_to(eye_aug, (n_pad - n, r, w))], axis=0)
     Mt = jnp.transpose(M.reshape(n_pad, r * w), (1, 0))  # (r*w, n_pad)
+    # last row: per-system pivot floor (0 for identity padding -> inert)
+    floor = jnp.pad(0.5 * reg.astype(M.dtype), (0, n_pad - n))
+    Mt = jnp.concatenate([Mt, floor[None, :]], axis=0)   # (r*w+1, n_pad)
 
     out = pl.pallas_call(
         partial(_gj_kernel, r=r),
         grid=(n_pad // _BN,),
-        in_specs=[pl.BlockSpec((r * w, _BN), lambda i: (0, i))],
+        in_specs=[pl.BlockSpec((r * w + 1, _BN), lambda i: (0, i))],
         out_specs=pl.BlockSpec((r, _BN), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((r, n_pad), M.dtype),
         interpret=interpret,
