@@ -44,9 +44,15 @@ What runs (nothing is short-circuited):
 Data is synthetic at ML-20M scale (138k users x 27k items x 20M ratings;
 zero-egress environment) with a power-law profile. Prints ONE JSON line.
 
+Correctness is gated, not just printed (round-4 postmortem): non-finite
+model checksums, an at-scale hybrid-vs-csrb RMSE parity gap > 1%, or an
+inverted eval-grid ordering exit nonzero so the driver records a FAILED
+bench instead of a garbage headline.
+
 Env knobs: BENCH_NNZ / BENCH_USERS / BENCH_ITEMS / BENCH_ITERS /
 BENCH_DATA_SEED override the workload (smoke-testing on CPU);
-BENCH_SKIP_HTTP=1 skips the ingestion sample.
+BENCH_SKIP_HTTP=1 skips the ingestion sample; BENCH_SKIP_PARITY=1 skips
+the dual-kernel parity leg.
 """
 
 from __future__ import annotations
@@ -54,6 +60,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import sys
 import tempfile
 import time
 
@@ -109,9 +116,17 @@ def seed_event_store(storage, app_id, u, i, r, n_users):
 
 
 def measure_http_ingest(storage, n_users, n_items,
-                        n_events: int = 20_000):
+                        n_events: int = 20_000,
+                        conn_counts=(1, 8, 32)):
     """Front-door ingestion: POST /batch/events.json in cap-50 batches
-    against a second throwaway app (EventServer.scala:70 parity)."""
+    against a second throwaway app (EventServer.scala:70 parity).
+
+    Measured at N parallel keep-alive connections (the reference's real
+    load shape is many SDK clients against one event server; HBase spreads
+    them over region servers — HBEventsUtil.scala:84-131 — while this
+    framework's eventlog takes them on one writer process whose WAL/buffer
+    appends are lock-serialized; see eventlog.py "Concurrency"). Returns
+    {n_conns: events_per_s}."""
     import http.client
     import socket
     import threading
@@ -143,22 +158,67 @@ def measure_http_ingest(storage, n_users, n_items,
              "targetEntityType": "item", "targetEntityId": f"i{ii[k]}",
              "properties": {"rating": float(rr[k])}}
             for k in range(lo, hi)]).encode())
+
+    def pump(my_batches, errors):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            for body in my_batches:
+                conn.request("POST",
+                             f"/batch/events.json?accessKey={key}",
+                             body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+                assert resp.status == 200, payload[:200]
+            conn.close()
+        except Exception as e:   # surfaced after join
+            errors.append(e)
+
+    out = {}
     try:
-        conn = http.client.HTTPConnection("127.0.0.1", port)
-        conn.connect()
-        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        t0 = time.perf_counter()
-        for body in batches:
-            conn.request("POST", f"/batch/events.json?accessKey={key}",
-                         body=body,
-                         headers={"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            payload = resp.read()
-            assert resp.status == 200, payload[:200]
-        dt = time.perf_counter() - t0
+        for n_conns in conn_counts:
+            errors: list = []
+            slices = [batches[k::n_conns] for k in range(n_conns)]
+            threads = [threading.Thread(target=pump, args=(s, errors))
+                       for s in slices if s]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            out[n_conns] = n_events / dt
     finally:
         server.shutdown()
-    return n_events / dt
+    return out
+
+
+def measure_kernel_parity(u, i, r, n_users, n_items, iters: int = 10):
+    """Hybrid-vs-csrb numerical parity AT SCALE on the attached device
+    (round-4 postmortem: the 296-test CPU suite never trains >500k nnz, so
+    a kernel that diverges only at 20M shipped a NaN headline). Trains both
+    kernels on the bench data, same seed, and compares training RMSE.
+    Returns (rmse_hybrid, rmse_csrb, rel_diff); non-finite factors or a
+    rel_diff above 1% must fail the bench run."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import als
+
+    data = als.prepare_ratings(u, i, r, n_users, n_items, device=True)
+    bu = data.by_user
+    mask = (bu.self_idx < n_users).astype(jnp.float32)
+    out = {}
+    for kern in ("hybrid", "csrb"):
+        U, V = als.train_explicit(data, rank=10, iterations=iters,
+                                  lambda_=0.01, seed=11, kernel=kern)
+        out[kern] = float(als.rmse(U, V, bu.self_idx, bu.other_idx,
+                                   bu.rating, mask))
+    rel = abs(out["hybrid"] - out["csrb"]) / max(out["csrb"], 1e-9)
+    return out["hybrid"], out["csrb"], rel
 
 
 def measure_eval_grid(storage, n_events: int = 100_000, n_users: int = 943,
@@ -166,7 +226,8 @@ def measure_eval_grid(storage, n_events: int = 100_000, n_users: int = 943,
     """The reference's default eval workload (Evaluation.scala:90-106 +
     BASELINE.md): rank {5,10,20} x iterations {1,5,10}, 5-fold CV,
     Precision@10, at MovieLens-100K scale, through run_evaluation with
-    FastEval memoization. Returns (wall_s, best_score, n_variants)."""
+    FastEval memoization. Returns (wall_s, best_score, n_variants,
+    ordering_ok)."""
     from predictionio_tpu.data.storage import App
     from predictionio_tpu.models.recommendation.evaluation import (
         RecommendationEvaluation, engine_params_list,
@@ -195,7 +256,26 @@ def measure_eval_grid(storage, n_events: int = 100_000, n_users: int = 943,
         ctx, RecommendationEvaluation(), params,
         evaluation_class="RecommendationEvaluation")
     wall = time.perf_counter() - t0
-    return wall, float(result.best_score.score), len(params)
+    # ordering assert (round-4 Weak #6): with a PLANTED low-rank signal,
+    # a correct trainer must order the grid sensibly — 2.4x random for the
+    # best variant alone proves wiring, not training. Converged variants
+    # (max iters in the grid) must beat the 1-iteration ones on average,
+    # and the weakest variant (min rank, min iters) must not win. Variant
+    # params are read from each score's own engine_params so grid edits
+    # cannot silently misalign the gate.
+    def variant(s):
+        ap = dict(s.engine_params.algorithm_params_list)["als"]
+        return ap.rank, ap.numIterations, float(s.score)
+
+    rows = [variant(s) for s in result.engine_params_scores]
+    max_iters = max(it for _r, it, _s in rows)
+    min_iters = min(it for _r, it, _s in rows)
+    mean_hi = np.mean([s for _r, it, s in rows if it == max_iters])
+    mean_lo = np.mean([s for _r, it, s in rows if it == min_iters])
+    weakest = min(rows, key=lambda t: (t[0], t[1]))[2]
+    ordering_ok = (mean_hi > mean_lo
+                   and float(result.best_score.score) > weakest)
+    return wall, float(result.best_score.score), len(params), ordering_ok
 
 
 def measure_ecom_serving(storage, big_app_users: int, n_queries: int = 200):
@@ -354,6 +434,20 @@ def main() -> None:
     except Exception:
         pass
 
+    def cache_stats():
+        """Compile-cache state, so a warmup_compile_s swing is explainable
+        from the artifact alone (round-4 Weak #4: 136 s -> 419 s with no
+        recorded cause). entries==0 before a run means fully cold."""
+        try:
+            files = [os.path.join(cache_dir, f)
+                     for f in os.listdir(cache_dir)]
+            return {"entries": len(files),
+                    "bytes": int(sum(os.path.getsize(f) for f in files))}
+        except OSError:
+            return {"entries": 0, "bytes": 0}
+
+    cache_before = cache_stats()
+
     from predictionio_tpu.controller.engine import EngineParams
     from predictionio_tpu.data.storage import App, Storage
     from predictionio_tpu.models.recommendation import (
@@ -383,7 +477,6 @@ def main() -> None:
         app_id = storage.get_meta_data_apps().insert(App(0, "BenchApp"))
         u, i, r = synth_codes(n_users, n_items, nnz, data_seed)
         write_s = seed_event_store(storage, app_id, u, i, r, n_users)
-        del u, i, r
 
         http_eps = None
         if os.environ.get("BENCH_SKIP_HTTP") != "1":
@@ -440,15 +533,32 @@ def main() -> None:
 
         p50_ms, p99_ms = serve_and_measure(storage, engine)
 
+        # parity leg AFTER the timed passes: it reuses the already-compiled
+        # hybrid program and adds only the csrb one, so warmup_compile_s
+        # above stays an honest per-process compile measurement
+        parity = None
+        if os.environ.get("BENCH_SKIP_PARITY") != "1":
+            p_h, p_c, p_rel = measure_kernel_parity(
+                u, i, r, n_users, n_items)
+            parity = {"parity_rmse_hybrid": round(p_h, 6),
+                      "parity_rmse_csrb": round(p_c, 6),
+                      "parity_rel_diff": round(p_rel, 6),
+                      "parity_ok": bool(np.isfinite(p_h)
+                                        and np.isfinite(p_c)
+                                        and p_rel < 0.01)}
+        del u, i, r
+
         eval_grid = ecom = None
         if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
             try:
                 ev_events = int(os.environ.get("BENCH_EVAL_EVENTS", 100_000))
                 t0 = time.perf_counter()
-                ew, best, nvar = measure_eval_grid(storage, ev_events)
+                ew, best, nvar, ord_ok = measure_eval_grid(
+                    storage, ev_events)
                 eval_grid = {"eval_grid_s": round(ew, 3),
                              "eval_variants": nvar,
-                             "eval_best_p_at_10": round(best, 4)}
+                             "eval_best_p_at_10": round(best, 4),
+                             "eval_ordering_ok": bool(ord_ok)}
             except Exception as e:  # extras must never sink the headline
                 eval_grid = {"eval_error": f"{type(e).__name__}: {e}"}
             try:
@@ -487,14 +597,27 @@ def main() -> None:
                 "phase_persist_s": round(ph_a1.get("persist", 0.0), 3),
                 "layout_s_runs": layouts,
                 "event_store_write_s": round(write_s, 3),
-                "http_ingest_events_per_s": (round(http_eps)
-                                             if http_eps else None),
+                "http_ingest_events_per_s": (
+                    {str(k): round(v) for k, v in http_eps.items()}
+                    if http_eps else None),
                 # remote-compile through the device tunnel; the local
                 # persistent cache does not apply, so this is paid per
                 # process and is NOT part of any steady-state claim
                 "warmup_compile_s": round(warm_s, 3),
+                "compile_cache": {"dir": cache_dir,
+                                  "before": cache_before,
+                                  "after": cache_stats()},
+                "kernel_knobs": {
+                    k: os.environ.get(k, d) for k, d in (
+                        ("PIO_ALS_KERNEL", "hybrid"),
+                        ("PIO_ALS_HOT_K", "4096"),
+                        ("PIO_ALS_DENSE_MIN_COUNT", "64"),
+                        ("PIO_ALS_XPAD", "1"),
+                        ("PIO_ALS_SOLVER", "gj"),
+                        ("PIO_NNZ_BUCKETING", "1"))},
                 "checksums": [round(ck_a1, 2), round(ck_a2, 2),
                               round(ck_b1, 2), round(ck_b2, 2)],
+                **(parity or {}),
                 "serve_http_p50_ms": round(p50_ms, 3),
                 "serve_http_p99_ms": round(p99_ms, 3),
                 **(eval_grid or {}),
@@ -502,6 +625,24 @@ def main() -> None:
                 "device": str(jax.devices()[0]).split(":")[0],
             },
         }))
+
+        # hard gates (round-4 Weak #2a: the bench PRINTED [NaN,NaN,NaN,NaN]
+        # checksums and the round still shipped an 87.8 ms/iter headline
+        # measured on that garbage model) — a non-finite model, an at-scale
+        # kernel-parity failure, or an inverted eval ordering is a FAILED
+        # bench run, visible to the driver as a nonzero exit code
+        failures = []
+        if not all(np.isfinite(c)
+                   for c in (ck_a1, ck_a2, ck_b1, ck_b2)):
+            failures.append("non-finite model checksum")
+        if parity is not None and not parity["parity_ok"]:
+            failures.append("hybrid-vs-csrb parity failure at scale")
+        if eval_grid is not None and eval_grid.get(
+                "eval_ordering_ok") is False:
+            failures.append("eval grid ordering inverted")
+        if failures:
+            print("BENCH FAILED: " + "; ".join(failures), file=sys.stderr)
+            sys.exit(1)
     finally:
         try:
             storage.get_events().close()   # flush before the dir vanishes

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import hashlib
+import logging
 import os
 import tempfile
 from typing import (Any, Callable, Dict, Iterable, List, Mapping, Optional,
@@ -247,6 +248,17 @@ def create(
         if row is not None:
             rows.append(row)
     cols = _columnar(rows)
+
+    if until_time is None:
+        # "now" landed in the cache key: the entry is unreachable by
+        # construction, so writing it would only accumulate orphaned .npz
+        # files under base_dir (see docstring)
+        logging.getLogger("predictionio_tpu.data.view").warning(
+            "view.create(name=%r) called without until_time: the snapshot "
+            "cache is keyed on a fixed 'now' and can never be hit again, "
+            "so no snapshot is written. Pass an explicit until_time to "
+            "enable caching.", name)
+        return cols
 
     # unique temp name: concurrent misses on the same key each write their
     # own file and the replace is last-writer-wins on identical content

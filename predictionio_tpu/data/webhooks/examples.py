@@ -1,0 +1,146 @@
+"""The SPI-demo webhook connector pair.
+
+Reference: data/.../webhooks/examplejson/ExampleJsonConnector.scala and
+data/.../webhooks/exampleform/ExampleFormConnector.scala — the pair of
+documented example connectors new integrations copy from. Both accept two
+payload types:
+
+  userAction      -> entityType "user" event (context + two extra props)
+  userActionItem  -> user->item event (context + two extra props)
+
+The JSON variant takes nested objects; the form variant takes flat
+key/value pairs with PHP-style bracketed context keys ("context[ip]").
+Like the reference, these are NOT in the default connector registries
+(WebhooksConnectors.scala registers only segmentio + mailchimp); they
+exist as templates and are exercised by tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from predictionio_tpu.data.webhooks import (
+    ConnectorException, FormConnector, JsonConnector,
+)
+
+
+def _require(data: Dict[str, Any], field: str) -> Any:
+    if field not in data:
+        raise ConnectorException(f"The field '{field}' is required.")
+    return data[field]
+
+
+class ExampleJsonConnector(JsonConnector):
+    """ExampleJsonConnector.scala:28-130."""
+
+    def to_event_json(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        typ = _require(data, "type")
+        if typ == "userAction":
+            return self._user_action(data)
+        if typ == "userActionItem":
+            return self._user_action_item(data)
+        raise ConnectorException(
+            f"Cannot convert unknown type '{typ}' to Event JSON.")
+
+    def _user_action(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        props: Dict[str, Any] = {
+            "anotherProperty1": int(_require(data, "anotherProperty1")),
+        }
+        if data.get("context") is not None:
+            props["context"] = data["context"]
+        if data.get("anotherProperty2") is not None:
+            props["anotherProperty2"] = data["anotherProperty2"]
+        return {
+            "event": _require(data, "event"),
+            "entityType": "user",
+            "entityId": _require(data, "userId"),
+            "eventTime": _require(data, "timestamp"),
+            "properties": props,
+        }
+
+    def _user_action_item(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        props: Dict[str, Any] = {"context": _require(data, "context")}
+        if data.get("anotherPropertyA") is not None:
+            props["anotherPropertyA"] = float(data["anotherPropertyA"])
+        if data.get("anotherPropertyB") is not None:
+            props["anotherPropertyB"] = bool(data["anotherPropertyB"])
+        return {
+            "event": _require(data, "event"),
+            "entityType": "user",
+            "entityId": _require(data, "userId"),
+            "targetEntityType": "item",
+            "targetEntityId": _require(data, "itemId"),
+            "eventTime": _require(data, "timestamp"),
+            "properties": props,
+        }
+
+
+class ExampleFormConnector(FormConnector):
+    """ExampleFormConnector.scala:27-140: flat form fields, context
+    encoded as bracketed keys ("context[ip]", "context[prop1]", ...)."""
+
+    def to_event_json(self, data: Dict[str, str]) -> Dict[str, Any]:
+        typ = _require(data, "type")
+        try:
+            if typ == "userAction":
+                return self._user_action(data)
+            if typ == "userActionItem":
+                return self._user_action_item(data)
+        except ConnectorException:
+            raise
+        except Exception as e:
+            raise ConnectorException(
+                f"Cannot convert {data} to event JSON. {e}") from e
+        raise ConnectorException(
+            f"Cannot convert unknown type {typ} to event JSON")
+
+    @staticmethod
+    def _context(data: Dict[str, str],
+                 required: bool) -> Optional[Dict[str, Any]]:
+        has = any(k.startswith("context[") for k in data)
+        if not has:
+            if required:
+                raise ConnectorException(
+                    "The field 'context[...]' is required.")
+            return None
+        ctx: Dict[str, Any] = {}
+        if "context[ip]" in data:
+            ctx["ip"] = data["context[ip]"]
+        if "context[prop1]" in data:
+            ctx["prop1"] = float(data["context[prop1]"])
+        if "context[prop2]" in data:
+            ctx["prop2"] = data["context[prop2]"]
+        return ctx
+
+    def _user_action(self, data: Dict[str, str]) -> Dict[str, Any]:
+        props: Dict[str, Any] = {
+            "anotherProperty1": int(_require(data, "anotherProperty1")),
+        }
+        ctx = self._context(data, required=False)
+        if ctx is not None:
+            props["context"] = ctx
+        if data.get("anotherProperty2") is not None:
+            props["anotherProperty2"] = data["anotherProperty2"]
+        return {
+            "event": _require(data, "event"),
+            "entityType": "user",
+            "entityId": _require(data, "userId"),
+            "eventTime": _require(data, "timestamp"),
+            "properties": props,
+        }
+
+    def _user_action_item(self, data: Dict[str, str]) -> Dict[str, Any]:
+        props: Dict[str, Any] = {"context": self._context(data, required=True)}
+        if data.get("anotherPropertyA") is not None:
+            props["anotherPropertyA"] = float(data["anotherPropertyA"])
+        if data.get("anotherPropertyB") is not None:
+            props["anotherPropertyB"] = data["anotherPropertyB"] == "true"
+        return {
+            "event": _require(data, "event"),
+            "entityType": "user",
+            "entityId": _require(data, "userId"),
+            "targetEntityType": "item",
+            "targetEntityId": _require(data, "itemId"),
+            "eventTime": _require(data, "timestamp"),
+            "properties": props,
+        }

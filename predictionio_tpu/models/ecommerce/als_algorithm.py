@@ -42,10 +42,12 @@ class ECommAlgorithmParams(Params):
     numIterations: int = 20
     lambda_: float = 0.01
     seed: Optional[int] = None
-    #: weighted-items variant: live $set constraint/weightedItems boosts.
-    #: One extra event-store point read per query; disable to keep the
-    #: base template's two-lookup hot path.
-    weightedItems: bool = True
+    #: weighted-items variant: live $set constraint/weightedItems boosts
+    #: (weighted-items/ALSAlgorithm.scala:234-261). Off by default — the
+    #: base reference template has a two-lookup hot path, and this adds an
+    #: event-store point read (plus an O(n_items) weight vector when the
+    #: constraint exists) per query. Opt in via engine.json.
+    weightedItems: bool = False
 
     JSON_ALIASES = {"lambda": "lambda_"}
 

@@ -403,6 +403,22 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_unregister(args) -> int:
+    """Console.scala:170-175 parity: the verb parses but engine
+    registration metadata no longer exists (the reference removed its
+    sbt registry; its own dispatch falls through to help + exit 1)."""
+    _error("Nothing to unregister: engines are not registered — `pio "
+           "build` validates in place and `pio train --engine-dir` points "
+           "at the engine directory directly.")
+    return 1
+
+
+def cmd_upgrade(args) -> int:
+    """Console.scala:396-399 + :664-666 parity (verbatim behavior)."""
+    _error("Upgrade is no longer supported")
+    return 1
+
+
 def _confirm(prompt: str) -> bool:
     answer = input(f"{prompt} (Y/n) ")
     return answer.strip().lower() in ("", "y", "yes")
@@ -551,6 +567,12 @@ def build_parser() -> argparse.ArgumentParser:
     t = tsub.add_parser("get")
     t.add_argument("name", nargs="?")
 
+    sp = sub.add_parser(
+        "unregister",
+        help="unregister an engine (no-op; Console.scala:170 parity)")
+    sp.add_argument("--engine-dir", default=".")
+    sub.add_parser("upgrade", help="no longer supported")
+
     sp = sub.add_parser("import", help="import events from a JSON-lines file")
     sp.add_argument("--appid", type=int, required=True)
     sp.add_argument("--channel", default=None)
@@ -582,6 +604,8 @@ _DISPATCH = {
     "template": cmd_template,
     "import": cmd_import,
     "export": cmd_export,
+    "unregister": cmd_unregister,
+    "upgrade": cmd_upgrade,
 }
 
 

@@ -271,7 +271,7 @@ class TestECommerce:
         scores per item group; buried items drop out of the top, boosted
         ones rise, and queries without the constraint are untouched."""
         from predictionio_tpu.models.ecommerce import Query
-        algo, model, _td = self._train(memory_storage)
+        algo, model, _td = self._train(memory_storage, weightedItems=True)
         base = algo.predict(model, Query(user="u1", num=3))
         top = {s.item for s in base.itemScores}
         assert top <= {"i1", "i3", "i5"}

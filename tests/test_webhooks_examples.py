@@ -1,0 +1,83 @@
+"""SPI-demo connector pair (ExampleJsonConnector.scala /
+ExampleFormConnector.scala parity): both payload types of each variant
+convert to valid Events; malformed payloads raise ConnectorException."""
+
+import pytest
+
+from predictionio_tpu.data.webhooks import ConnectorException, to_event
+from predictionio_tpu.data.webhooks.examples import (
+    ExampleFormConnector, ExampleJsonConnector,
+)
+
+
+def test_json_user_action_roundtrip():
+    ev = to_event(ExampleJsonConnector(), {
+        "type": "userAction", "userId": "as34smg4", "event": "do_something",
+        "context": {"ip": "24.5.68.47", "prop1": 2.345, "prop2": "value1"},
+        "anotherProperty1": 100, "anotherProperty2": "optional1",
+        "timestamp": "2015-01-02T00:30:12.984Z"})
+    assert ev.event == "do_something"
+    assert ev.entity_type == "user" and ev.entity_id == "as34smg4"
+    assert ev.properties.get("anotherProperty1") == 100
+    assert ev.properties.get("context")["ip"] == "24.5.68.47"
+    assert ev.event_time.year == 2015
+
+
+def test_json_user_action_item_roundtrip():
+    ev = to_event(ExampleJsonConnector(), {
+        "type": "userActionItem", "userId": "as34smg4",
+        "event": "do_something_on", "itemId": "kfjd312bc",
+        "context": {"ip": "1.23.4.56", "prop1": 2.345, "prop2": "value1"},
+        "anotherPropertyA": 4.567, "anotherPropertyB": False,
+        "timestamp": "2015-01-15T04:20:23.567Z"})
+    assert ev.target_entity_type == "item"
+    assert ev.target_entity_id == "kfjd312bc"
+    assert ev.properties.get("anotherPropertyA") == pytest.approx(4.567)
+
+
+def test_json_unknown_and_missing_type():
+    with pytest.raises(ConnectorException, match="unknown type"):
+        ExampleJsonConnector().to_event_json({"type": "nope"})
+    with pytest.raises(ConnectorException, match="required"):
+        ExampleJsonConnector().to_event_json({"userId": "x"})
+
+
+def test_form_user_action_optional_context():
+    c = ExampleFormConnector()
+    # without any context[...] key the context property is absent
+    j = c.to_event_json({
+        "type": "userAction", "userId": "u1", "event": "do_something",
+        "anotherProperty1": "100",
+        "timestamp": "2015-01-02T00:30:12.984Z"})
+    assert "context" not in j["properties"]
+    assert j["properties"]["anotherProperty1"] == 100
+    # bracketed context keys parse into a nested object with typed values
+    j = c.to_event_json({
+        "type": "userAction", "userId": "u1", "event": "do_something",
+        "context[ip]": "24.5.68.47", "context[prop1]": "2.345",
+        "anotherProperty1": "100",
+        "timestamp": "2015-01-02T00:30:12.984Z"})
+    assert j["properties"]["context"] == {"ip": "24.5.68.47", "prop1": 2.345}
+
+
+def test_form_user_action_item_requires_context():
+    c = ExampleFormConnector()
+    with pytest.raises(ConnectorException, match="context"):
+        c.to_event_json({
+            "type": "userActionItem", "userId": "u1", "event": "e",
+            "itemId": "i1", "timestamp": "2015-01-15T04:20:23.567Z"})
+    ev = to_event(c, {
+        "type": "userActionItem", "userId": "u1", "event": "view",
+        "itemId": "i1", "context[ip]": "1.2.3.4", "context[prop1]": "1.5",
+        "anotherPropertyB": "true",
+        "timestamp": "2015-01-15T04:20:23.567Z"})
+    assert ev.properties.get("anotherPropertyB") is True
+    assert ev.properties.get("context")["prop1"] == 1.5
+
+
+def test_form_bad_number_is_connector_error():
+    with pytest.raises(ConnectorException, match="Cannot convert"):
+        ExampleFormConnector().to_event_json({
+            "type": "userAction", "userId": "u1", "event": "e",
+            "anotherProperty1": "not-a-number",
+            "timestamp": "2015-01-02T00:30:12.984Z"})
