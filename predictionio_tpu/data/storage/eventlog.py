@@ -32,6 +32,27 @@ process at any time: every read refreshes the dictionary and WAL tails by
 file offset (chunks are immutable once written), so a deployed engine
 server sees the ingesting server's events, including unflushed ones.
 
+Multi-writer topology (the HBase-parity story, HBEventsUtil.scala:84-131:
+MD5-prefixed rowkeys let many region servers ingest one app's events):
+
+- WITHIN one event-server process, appends are RLock-serialized and any
+  number of HTTP connections share the writer — `bench.py` measures
+  POST /batch/events.json at 1/8/32 parallel connections. Ingestion is
+  parse-bound (GIL), so connections add concurrency headroom, not linear
+  throughput; the lock itself is not the bottleneck.
+- ACROSS processes, writers must route through the single owner: either
+  the event server itself, or `pio storageserver` (the remote backend,
+  data/storage/remote.py) which gives any number of driver processes a
+  shared binary-RPC path into the one WAL owner.
+- HORIZONTAL scale-out shards by CHANNEL: each (app, channel) is an
+  independent directory + WAL + dictionary, so N event-server processes
+  each owning a disjoint channel set ingest in parallel with zero
+  coordination — the analogue of HBase spreading regions across region
+  servers. Training reads merge channels through the normal reader path.
+  A process must never open a WAL it does not own; there is no file lock
+  enforcing this (deployment contract, as with the reference's region
+  assignment).
+
 The generic `find` surface (full LEvents filter parity) is implemented with
 vectorized chunk filters and materializes Event objects only for matching
 rows, so the contract suite runs unmodified while the training path never

@@ -17,6 +17,7 @@ Spark executors. The TPU-native analogue is twofold:
 from __future__ import annotations
 
 import datetime as _dt
+import time as _time
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -291,8 +292,13 @@ def find_columnar(
     entity_vocab: Optional[BiMap] = None,
     target_vocab: Optional[BiMap] = None,
     storage: Optional[Storage] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> ColumnarEvents:
     """Single-pass events → columnar buffers + vocabs.
+
+    `timings`, when given, receives {"read_io": s, "read_encode": s} on the
+    columnar fast path (store scan vs vocab-encode split — the bench
+    reports these as read sub-phases).
 
     This replaces the reference's full Spark job for `BiMap.stringInt`
     (BiMap.scala:96-128) plus the per-template `.map`/`.filter` RDD chains:
@@ -308,6 +314,7 @@ def find_columnar(
     events_dao = storage.get_events()
     if hasattr(events_dao, "read_columns"):
         app_id, channel_id = _resolve_app(app_name, channel_name, storage)
+        t0 = _time.perf_counter()
         try:
             cols = events_dao.read_columns(
                 app_id, channel_id, event_names=event_names,
@@ -319,8 +326,13 @@ def find_columnar(
             # reports it this way; fall through to the per-event path
             cols = None
         if cols is not None:
-            return _columnar_from_codes(cols, event_names, entity_vocab,
-                                        target_vocab)
+            t1 = _time.perf_counter()
+            out = _columnar_from_codes(cols, event_names, entity_vocab,
+                                       target_vocab)
+            if timings is not None:
+                timings["read_io"] = t1 - t0
+                timings["read_encode"] = _time.perf_counter() - t1
+            return out
     events = find(
         app_name, channel_name=channel_name, event_names=event_names,
         entity_type=entity_type, target_entity_type=target_entity_type,

@@ -63,7 +63,13 @@ class ExampleJsonConnector(JsonConnector):
         if data.get("anotherPropertyA") is not None:
             props["anotherPropertyA"] = float(data["anotherPropertyA"])
         if data.get("anotherPropertyB") is not None:
-            props["anotherPropertyB"] = bool(data["anotherPropertyB"])
+            v = data["anotherPropertyB"]
+            if not isinstance(v, bool):
+                # bool("false") is True — reject like the reference's
+                # typed extraction instead of storing an inverted value
+                raise ConnectorException(
+                    f"anotherPropertyB must be a boolean, got {v!r}")
+            props["anotherPropertyB"] = v
         return {
             "event": _require(data, "event"),
             "entityType": "user",
@@ -134,7 +140,13 @@ class ExampleFormConnector(FormConnector):
         if data.get("anotherPropertyA") is not None:
             props["anotherPropertyA"] = float(data["anotherPropertyA"])
         if data.get("anotherPropertyB") is not None:
-            props["anotherPropertyB"] = data["anotherPropertyB"] == "true"
+            v = str(data["anotherPropertyB"]).strip().lower()
+            if v not in ("true", "false"):
+                # Scala's .toBoolean throws on anything else
+                raise ConnectorException(
+                    f"anotherPropertyB must be 'true' or 'false', got "
+                    f"{data['anotherPropertyB']!r}")
+            props["anotherPropertyB"] = v == "true"
         return {
             "event": _require(data, "event"),
             "entityType": "user",

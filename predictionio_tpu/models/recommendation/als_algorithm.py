@@ -54,6 +54,46 @@ class ALSModel:
                 f"items={len(self.item_vocab)})")
 
 
+#: one-entry process-wide device-layout cache for full-scale trains.
+#: Keyed on a CONTENT fingerprint (cheap meta tuple + crc32 over the three
+#: COO arrays): a changed event store can never reuse a stale layout, and
+#: the crc costs ~0.2 s at 20M vs ~10 s of transfer + in-HBM sorts. The
+#: crc only runs when the cheap meta prefix already matches, and is
+#: computed at most once per train (threaded from probe to store).
+_BIG_LAYOUT_CACHE: list = []   # [(meta, crc, ALSData)]
+
+
+def _layout_meta(td, use_mesh: bool):
+    return (use_mesh, td.n, len(td.user_vocab), len(td.item_vocab))
+
+
+def _layout_crc(td) -> int:
+    import zlib
+    h = 0
+    for a in (td.user_idx, td.item_idx, td.rating):
+        h = zlib.crc32(np.ascontiguousarray(a).view(np.uint8), h)
+    return h
+
+
+def _big_layout_cached(td, use_mesh: bool):
+    """-> (data_or_None, crc_or_None). crc is returned when computed so a
+    following store never hashes the same arrays twice."""
+    if not als._layout_cache_enabled() or not _BIG_LAYOUT_CACHE:
+        return None, None
+    meta, crc, data = _BIG_LAYOUT_CACHE[0]
+    if meta != _layout_meta(td, use_mesh):
+        return None, None
+    got = _layout_crc(td)
+    return (data, got) if got == crc else (None, got)
+
+
+def _big_layout_store(td, use_mesh: bool, data, crc=None) -> None:
+    if als._layout_cache_enabled():
+        if crc is None:
+            crc = _layout_crc(td)
+        _BIG_LAYOUT_CACHE[:] = [(_layout_meta(td, use_mesh), crc, data)]
+
+
 class ALSAlgorithm(Algorithm):
     params_class = ALSAlgorithmParams
     query_class = Query
@@ -82,16 +122,31 @@ class ALSAlgorithm(Algorithm):
             # the COO layout is rank-independent, so an eval grid's variants
             # sharing one fold (FastEval memoizes the PreparedData object)
             # reuse it instead of re-sorting the same ratings per variant.
-            # Only eval-scale data is cached: a full-scale single train is
-            # laid out once anyway, and pinning its device-resident layout
-            # to the TrainingData would extend 100s of MB of HBM past train
-            cacheable = td.n <= 2_000_000
+            # Eval-scale data caches on the TrainingData object; FULL-scale
+            # data (td.n > 2M) caches ONE entry process-wide keyed on a
+            # content fingerprint, so repeat trains over an unchanged event
+            # store (the bench's slope passes; retrain-on-deploy) skip the
+            # transfer + in-HBM sorts entirely. The retained HBM (~0.5 GB
+            # at 20M) is bounded at one entry; PIO_ALS_LAYOUT_CACHE=0
+            # disables retention.
+            import os
+            cacheable = td.n <= int(os.environ.get(
+                "PIO_ALS_BIG_LAYOUT_MIN", 2_000_000))
             cache_key = ("als_layout", use_mesh)
             cached = getattr(td, "_pio_layout_cache", None) \
                 if cacheable else None
+            big_crc = None
             if cached is not None and cached[0] == cache_key:
                 data = cached[1]
             else:
+                data, big_crc = _big_layout_cached(td, use_mesh)
+            if data is None:
+                if not cacheable:
+                    # evict stale entries BEFORE building the replacement:
+                    # holding the old device layout + hybrid prep across
+                    # the rebuild would transiently double retained HBM
+                    _BIG_LAYOUT_CACHE.clear()
+                    als._HYBRID_CACHE.clear()
                 data = als.prepare_ratings(
                     td.user_idx, td.item_idx, td.rating,
                     n_users=len(td.user_vocab), n_items=len(td.item_vocab),
@@ -109,6 +164,8 @@ class ALSAlgorithm(Algorithm):
                                     data.by_item.self_idx[-1:]))
                 if cacheable:
                     td._pio_layout_cache = (cache_key, data)
+                else:
+                    _big_layout_store(td, use_mesh, data, crc=big_crc)
         checkpointer = None
         ckpt_dir = getattr(ctx, "checkpoint_dir", None)
         if self.ap.checkpointInterval and ckpt_dir:

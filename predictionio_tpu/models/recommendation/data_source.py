@@ -97,6 +97,7 @@ class DataSource(BaseDataSource):
 
     def _get_ratings(self, ctx,
                      entity_vocab=None, target_vocab=None) -> TrainingData:
+        timings: Dict[str, float] = {}
         col = store.find_columnar(
             self.dsp.appName,
             entity_type="user",
@@ -106,7 +107,13 @@ class DataSource(BaseDataSource):
             entity_vocab=entity_vocab,
             target_vocab=target_vocab,
             storage=ctx.storage,
+            timings=timings,
         )
+        # sub-phase visibility: store scan vs vocab-encode inside "read"
+        sink = getattr(ctx, "phase_seconds", None)
+        if sink is not None:
+            for k, v in timings.items():
+                sink[k] = sink.get(k, 0.0) + v
         return training_data_from_columnar(col)
 
     def read_training(self, ctx) -> TrainingData:

@@ -500,7 +500,9 @@ def _train_sharded_hybrid(
             U, V = UV
             # ---- user half-step: rows are local, V is fully gathered
             X = _expand_X(V, r, jnp.float32)          # (n_rows_pad_i, w)
-            X_hot = jnp.take(X, hot_addr, axis=0).astype(_HYBRID_DTYPE)
+            # f32 into the dense kernels: they split hi/lo bf16 internally
+            # (a pre-cast here would silently zero the lo correction term)
+            X_hot = jnp.take(X, hot_addr, axis=0)
             AB = _dense_hot_user(D_blk, X_hot, K, r)
             AB = AB + _gram_tail(X, u_lay, su.rows_dev, b, u_chunk,
                                  implicit, alpha, r)
@@ -511,8 +513,7 @@ def _train_sharded_hybrid(
             U = lax.all_gather(U_blk, axis, tiled=True)
             # ---- item half-step: dense partials psum over devices
             Z_local = _expand_X(U_blk, r, jnp.float32)
-            AB_hot = _dense_hot_item(D_blk, Z_local.astype(_HYBRID_DTYPE),
-                                     K, r)
+            AB_hot = _dense_hot_item(D_blk, Z_local, K, r)
             AB_hot = lax.psum(AB_hot, axis)           # (K, w) full
             Z = _expand_X(U, r, jnp.float32)
             ABi = _gram_tail(Z, i_lay, si.rows_dev, b, i_chunk,

@@ -1,6 +1,7 @@
 """ALS kernel correctness (parity target: MLlib ALS as used by the
 recommendation template, ALSAlgorithm.scala:50-94)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -199,6 +200,57 @@ def test_csrb_layout_roundtrip():
     # padding slots carry zero weight and a nondecreasing segment map
     assert np.all(np.diff(seg) >= 0)
     assert np.all(rat[pres == 0] == 0.0)
+
+
+def test_solve_factors_clamps_indefinite_rows():
+    """Round-4 postmortem regression: kernel rounding pushed per-row Grams
+    slightly indefinite and the unpivoted sweep turned a near-zero Schur
+    pivot into inf -> model-wide NaN two iterations later. The solve must
+    (a) stay exact on clean SPD systems and (b) return BOUNDED finite
+    solutions on indefinite ones (sign-preserving pivot magnitude floor)."""
+    rng = np.random.default_rng(0)
+    r, n = 6, 64
+    M = rng.normal(0, 1, (n, r, r)).astype(np.float32)
+    A = np.einsum("nij,nkj->nik", M, M)              # SPD batch
+    # poison a few rows: rank-1 negative update far beyond the ridge
+    for row in (3, 17, 40):
+        v = rng.normal(0, 1, r).astype(np.float32)
+        A[row] -= 3.0 * np.linalg.norm(A[row]) * np.outer(v, v) \
+            / np.dot(v, v)
+    b = rng.normal(0, 1, (n, r)).astype(np.float32)
+    reg = np.full(n, 0.05, np.float32)
+    x = np.asarray(als.solve_factors(
+        jnp.asarray(A), jnp.asarray(b), jnp.asarray(reg)))
+    assert np.isfinite(x).all()
+    clean = np.setdiff1d(np.arange(n), [3, 17, 40])
+    ref = np.linalg.solve(
+        A[clean] + reg[clean, None, None] * np.eye(r),
+        b[clean][..., None])[..., 0]
+    np.testing.assert_allclose(x[clean], ref, rtol=2e-3, atol=2e-3)
+    # bounded: the floor caps the inverse around 2/reg per sweep step
+    assert np.abs(x).max() < np.abs(b).max() * (2 / 0.05) * r
+
+
+def test_split_hilo_dense_path_precision():
+    """Round-4 postmortem regression: single-bf16 quantization of
+    X = [v(x)v | v] left ~4e-3 relative Gram error, which exceeded the
+    ridge once factors grew to |v|~50 at ML-20M. The split hi/lo pair
+    must keep the dense-hot Gram within ~1e-4 relative of the f32
+    reference at exactly those magnitudes (single-bf16 fails this by two
+    orders)."""
+    rng = np.random.default_rng(1)
+    n_u, K, r = 256, 32, 8
+    V_hot = (rng.normal(0, 1, (K, r)) * 50).astype(np.float32)
+    D = np.zeros((n_u, 2 * K), np.float32)
+    D[:, :K] = rng.integers(0, 3, (n_u, K))          # counts
+    D[:, K:] = D[:, :K] * rng.uniform(0.5, 5.0, (n_u, K))
+    X_hot = np.asarray(als._expand_X(jnp.asarray(V_hot), r, jnp.float32))
+    AB = np.asarray(als._dense_hot_user(
+        jnp.asarray(D, dtype=als._HYBRID_DTYPE), jnp.asarray(X_hot), K, r))
+    ref_gram = D[:, :K] @ X_hot[:, :r * r]
+    err = np.abs(AB[:, :r * r] - ref_gram).max()
+    scale = np.abs(ref_gram).max()
+    assert err / scale < 1e-4, f"dense gram rel err {err/scale:.2e}"
 
 
 @pytest.mark.parametrize("implicit", [False, True])

@@ -1,0 +1,102 @@
+"""Round-5 ETL caches: repeat trains over an unchanged event store skip
+the device layout (process-wide content-fingerprint cache) and the hybrid
+prep (identity-keyed cache); any data change invalidates both."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import als
+
+
+@pytest.fixture(autouse=True)
+def _clear_caches():
+    from predictionio_tpu.models.recommendation import als_algorithm
+    als_algorithm._BIG_LAYOUT_CACHE.clear()
+    als._HYBRID_CACHE.clear()
+    yield
+    als_algorithm._BIG_LAYOUT_CACHE.clear()
+    als._HYBRID_CACHE.clear()
+
+
+def _mk_td(seed=0, n=4000):
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.recommendation.data_source import (
+        TrainingData,
+    )
+    rng = np.random.default_rng(seed)
+    n_u, n_i = 60, 40
+    return TrainingData(
+        user_idx=rng.integers(0, n_u, n).astype(np.int32),
+        item_idx=rng.integers(0, n_i, n).astype(np.int32),
+        rating=rng.uniform(0.5, 5, n).astype(np.float32),
+        user_vocab=BiMap.string_int(f"u{k}" for k in range(n_u)),
+        item_vocab=BiMap.string_int(f"i{k}" for k in range(n_i)),
+    )
+
+
+def test_big_layout_cache_hits_and_invalidates(monkeypatch):
+    from predictionio_tpu.models.recommendation.als_algorithm import (
+        ALSAlgorithm, ALSAlgorithmParams,
+    )
+    monkeypatch.setenv("PIO_ALS_BIG_LAYOUT_MIN", "100")  # force big path
+    calls = []
+    real = als.prepare_ratings
+    monkeypatch.setattr(als, "prepare_ratings",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=3, numIterations=2, seed=1))
+    td1 = _mk_td(seed=0)
+    m1 = algo.train(None, type("P", (), {"ratings": td1})())
+    assert len(calls) == 1
+    # same CONTENT in a fresh TrainingData object -> layout reused
+    m2 = algo.train(None, type("P", (), {"ratings": _mk_td(seed=0)})())
+    assert len(calls) == 1
+    np.testing.assert_array_equal(np.asarray(m1.user_factors),
+                                  np.asarray(m2.user_factors))
+    # one changed rating -> fingerprint differs -> rebuild
+    td3 = _mk_td(seed=0)
+    td3.rating[0] += 1.0
+    algo.train(None, type("P", (), {"ratings": td3})())
+    assert len(calls) == 2
+
+
+def test_big_layout_cache_disabled(monkeypatch):
+    from predictionio_tpu.models.recommendation.als_algorithm import (
+        ALSAlgorithm, ALSAlgorithmParams,
+    )
+    monkeypatch.setenv("PIO_ALS_BIG_LAYOUT_MIN", "100")
+    monkeypatch.setenv("PIO_ALS_LAYOUT_CACHE", "0")
+    calls = []
+    real = als.prepare_ratings
+    monkeypatch.setattr(als, "prepare_ratings",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=3, numIterations=2, seed=1))
+    algo.train(None, type("P", (), {"ratings": _mk_td()})())
+    algo.train(None, type("P", (), {"ratings": _mk_td()})())
+    assert len(calls) == 2
+
+
+def test_hybrid_prep_cache_identity_keyed(monkeypatch):
+    monkeypatch.setenv("PIO_ALS_HOT_K", "16")
+    monkeypatch.setenv("PIO_ALS_DENSE_MIN_COUNT", "4")
+    rng = np.random.default_rng(2)
+    n_u, n_i, nnz = 120, 80, 4000
+    ui = rng.integers(0, n_u, nnz).astype(np.int32)
+    ii = rng.integers(0, n_i, nnz).astype(np.int32)
+    vals = rng.uniform(0.5, 5, nnz).astype(np.float32)
+    data = als.prepare_ratings(ui, ii, vals, n_u, n_i, chunk=1024)
+    calls = []
+    real = als._hybrid_prepare
+    monkeypatch.setattr(als, "_hybrid_prepare",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    U1, V1 = als.train_explicit(data, rank=3, iterations=2, lambda_=0.05,
+                                seed=5, chunk=1024, kernel="hybrid")
+    assert len(calls) == 1
+    # same ALSData object -> prep reused; warm-start continues training
+    U2, _ = als.train_explicit(data, rank=3, iterations=1, lambda_=0.05,
+                               u0=U1, v0=V1, chunk=1024, kernel="hybrid")
+    assert len(calls) == 1
+    # different ALSData object -> rebuilt
+    data2 = als.prepare_ratings(ui, ii, vals, n_u, n_i, chunk=1024)
+    als.train_explicit(data2, rank=3, iterations=1, lambda_=0.05,
+                       chunk=1024, kernel="hybrid")
+    assert len(calls) == 2

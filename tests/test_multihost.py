@@ -126,7 +126,11 @@ def test_two_process_mesh_matches_single_process(tmp_path, monkeypatch):
                                rtol=1e-5, atol=1e-6)
 
     # hybrid leg: two-process result matches a single-process 8-device
-    # hybrid run (same K/min-count env as the workers)
+    # hybrid run (same K/min-count env as the workers). Tolerance is
+    # looser than the csrb leg: the split-bf16 dense partials reduce via
+    # psum, and a 2-process (DCN) reduction tree orders the f32 adds
+    # differently than the single-program one — ~1e-5 drift is reduction
+    # order, not divergence (iterated 4x through the solve).
     monkeypatch.setenv("PIO_ALS_HOT_K", "8")
     monkeypatch.setenv("PIO_ALS_DENSE_MIN_COUNT", "4")
     Uh, Vh = als_dist.train_explicit_sharded(
@@ -135,6 +139,6 @@ def test_two_process_mesh_matches_single_process(tmp_path, monkeypatch):
     np.testing.assert_array_equal(np.asarray(got[0]["Uh"]),
                                   np.asarray(got[1]["Uh"]))
     np.testing.assert_allclose(np.asarray(got[0]["Uh"]), np.asarray(Uh),
-                               rtol=1e-5, atol=1e-6)
+                               rtol=1e-4, atol=5e-5)
     np.testing.assert_allclose(np.asarray(got[0]["Vh"]), np.asarray(Vh),
-                               rtol=1e-5, atol=1e-6)
+                               rtol=1e-4, atol=5e-5)

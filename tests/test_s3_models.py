@@ -1,0 +1,117 @@
+"""Object-store Models backend (S3Models.scala:36-95 parity) against an
+in-process S3-compatible fake: full Storage wiring, roundtrip, overwrite,
+missing-get, delete, error surfacing, and SigV4 header shape."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from predictionio_tpu.data.storage import Model, Storage
+
+
+class _FakeS3(BaseHTTPRequestHandler):
+    store: dict = {}
+    seen_headers: list = []
+    fail_next: list = []       # status codes to force, consumed in order
+
+    def _respond(self, status, body=b""):
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        self.seen_headers.append(dict(self.headers.items()))
+        if self.fail_next:
+            return self._respond(self.fail_next.pop(0))
+        n = int(self.headers.get("Content-Length") or 0)
+        self.store[self.path] = self.rfile.read(n)
+        self._respond(200)
+
+    def do_GET(self):
+        if self.fail_next:
+            return self._respond(self.fail_next.pop(0))
+        if self.path in self.store:
+            self._respond(200, self.store[self.path])
+        else:
+            self._respond(404)
+
+    def do_DELETE(self):
+        self.store.pop(self.path, None)
+        self._respond(204)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def s3_storage():
+    handler = type("H", (_FakeS3,), {"store": {}, "seen_headers": [],
+                                     "fail_next": []})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_S3_TYPE": "s3",
+        "PIO_STORAGE_SOURCES_S3_ENDPOINT": f"http://127.0.0.1:{port}",
+        "PIO_STORAGE_SOURCES_S3_BUCKET_NAME": "pio-models",
+        "PIO_STORAGE_SOURCES_S3_BASE_PATH": "prod/models",
+        "PIO_STORAGE_SOURCES_S3_ACCESS_KEY_ID": "AKIDEXAMPLE",
+        "PIO_STORAGE_SOURCES_S3_SECRET_ACCESS_KEY": "secret",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S3",
+    })
+    try:
+        yield storage, handler
+    finally:
+        server.shutdown()
+
+
+def test_roundtrip_overwrite_delete(s3_storage):
+    storage, handler = s3_storage
+    models = storage.get_model_data_models()
+    models.insert(Model(id="inst1", models=b"\x00blob-one"))
+    got = models.get("inst1")
+    assert got is not None and got.models == b"\x00blob-one"
+    # key layout: /<bucket>/<BASE_PATH>/<namespace>-<id>
+    assert "/pio-models/prod/models/pio_modeldata-inst1" in handler.store
+    # overwrite wins
+    models.insert(Model(id="inst1", models=b"blob-two"))
+    assert models.get("inst1").models == b"blob-two"
+    assert models.get("missing") is None
+    models.delete("inst1")
+    assert models.get("inst1") is None
+
+
+def test_sigv4_headers_present(s3_storage):
+    storage, handler = s3_storage
+    storage.get_model_data_models().insert(Model(id="x", models=b"y"))
+    hdrs = handler.seen_headers[-1]
+    auth = hdrs.get("authorization", "")
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/")
+    assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in auth
+    assert "Signature=" in auth
+    assert hdrs.get("x-amz-content-sha256")
+
+
+def test_put_failure_raises(s3_storage):
+    storage, handler = s3_storage
+    handler.fail_next.append(500)
+    with pytest.raises(IOError, match="PUT"):
+        storage.get_model_data_models().insert(Model(id="z", models=b"b"))
+
+
+def test_missing_bucket_rejected():
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_S3_TYPE": "s3",
+        "PIO_STORAGE_SOURCES_S3_ENDPOINT": "http://127.0.0.1:1",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S3",
+    })
+    with pytest.raises((ValueError, RuntimeError), match="BUCKET_NAME"):
+        storage.get_model_data_models()
