@@ -160,17 +160,32 @@ def measure_http_ingest(storage, n_users, n_items,
             for k in range(lo, hi)]).encode())
 
     def pump(my_batches, errors):
+        def connect():
+            c = http.client.HTTPConnection("127.0.0.1", port)
+            c.connect()
+            c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return c
+
         try:
-            conn = http.client.HTTPConnection("127.0.0.1", port)
-            conn.connect()
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = connect()
             for body in my_batches:
-                conn.request("POST",
-                             f"/batch/events.json?accessKey={key}",
-                             body=body,
-                             headers={"Content-Type": "application/json"})
-                resp = conn.getresponse()
-                payload = resp.read()
+                for attempt in (0, 1):
+                    try:
+                        conn.request(
+                            "POST",
+                            f"/batch/events.json?accessKey={key}",
+                            body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        break
+                    except (ConnectionError, http.client.HTTPException):
+                        # a dropped keep-alive is a reconnect, not a
+                        # failed benchmark (SDK clients do the same)
+                        if attempt:
+                            raise
+                        conn.close()
+                        conn = connect()
                 assert resp.status == 200, payload[:200]
             conn.close()
         except Exception as e:   # surfaced after join

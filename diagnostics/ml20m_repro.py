@@ -12,13 +12,14 @@ pivot tracking to see whether any Schur pivot goes <= 0.
 import os, sys, time
 import numpy as np
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+                      os.path.join(_REPO, ".jax_cache"))
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 from bench import synth_codes
 from predictionio_tpu.ops import als
 
@@ -34,13 +35,22 @@ print(f"prep {time.time()-t0:.1f}s", flush=True)
 
 U, V = als._seed_factors(SEED_F, N_U, N_I, RANK)
 
+IMPLICIT = os.environ.get("REPRO_IMPLICIT") == "1"
+
+
 def train_rmse(kernel):
     Uk, Vk = als._seed_factors(SEED_F, N_U, N_I, RANK)
     states = []
     for it in range(1, 11):
         t0 = time.time()
-        Uk, Vk = als.train_explicit(data, rank=RANK, iterations=1,
-                                    lambda_=LAM, u0=Uk, v0=Vk, kernel=kernel)
+        if IMPLICIT:
+            Uk, Vk = als.train_implicit(data, rank=RANK, iterations=1,
+                                        lambda_=LAM, alpha=1.0,
+                                        u0=Uk, v0=Vk, kernel=kernel)
+        else:
+            Uk, Vk = als.train_explicit(data, rank=RANK, iterations=1,
+                                        lambda_=LAM, u0=Uk, v0=Vk,
+                                        kernel=kernel)
         Uh = np.asarray(Uk); Vh = np.asarray(Vk)
         maxu = float(np.max(np.abs(Uh))); maxv = float(np.max(np.abs(Vh)))
         nan_u = int(np.sum(~np.isfinite(Uh).all(axis=1)))
@@ -76,9 +86,15 @@ last_ok = None
 for k, (Uh, Vh) in enumerate(states):
     if np.isfinite(Uh).all() and np.isfinite(Vh).all():
         last_ok = k
-print(f"== phase 2: analysing user half-step from state after iter "
-      f"{last_ok+1}", flush=True)
-Uh, Vh = states[last_ok]
+if last_ok is None:
+    # even iteration 1 blew up: analyse from the seed factors
+    print("== phase 2: no finite iteration; analysing from seed factors",
+          flush=True)
+    Uh, Vh = map(np.asarray, als._seed_factors(SEED_F, N_U, N_I, RANK))
+else:
+    print(f"== phase 2: analysing user half-step from state after iter "
+          f"{last_ok+1}", flush=True)
+    Uh, Vh = states[last_ok]
 V0 = jnp.asarray(Vh)
 
 # exact user-side Gram via csrb kernel
@@ -94,7 +110,9 @@ K = int(os.environ.get("PIO_ALS_HOT_K", als._HOT_K))
 hy = als._hybrid_prepare(data, K, False, 0.0, b, 1 << 18)
 rr = RANK
 X = als._expand_X(V0, rr, jnp.float32)
-X_hot = jnp.take(X, hy.hot_ids, axis=0).astype(als._HYBRID_DTYPE)
+# f32 into the dense kernel — it splits hi/lo internally; a pre-cast
+# would zero the lo correction and analyse a kernel production doesn't run
+X_hot = jnp.take(X, hy.hot_ids, axis=0)
 AB = als._dense_hot_user(hy.D, X_hot, hy.K, rr)
 AB = AB + als._gram_tail(X, hy.u_tail, N_U, b, hy.u_chunk, False, 0.0, rr)
 A_hy = np.asarray(AB[:, :rr*rr].reshape(N_U, rr, rr))

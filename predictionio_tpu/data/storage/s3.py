@@ -71,6 +71,10 @@ class StorageClient:
         self.secret_key = p.get(
             "SECRET_ACCESS_KEY",
             os.environ.get("AWS_SECRET_ACCESS_KEY", ""))
+        # temporary credentials (ECS/EKS/SSO) require the session token
+        # to ride along as a signed header or every request 403s
+        self.session_token = p.get(
+            "SESSION_TOKEN", os.environ.get("AWS_SESSION_TOKEN", ""))
         self.timeout = float(p.get("TIMEOUT_S", "60"))
 
     # ---- SigV4 (rfc-style canonical request; path-style addressing) ------
@@ -85,6 +89,8 @@ class StorageClient:
         if not self.access_key:
             headers.pop("x-amz-date")
             return headers     # unsigned (test fakes, anonymous endpoints)
+        if self.session_token:
+            headers["x-amz-security-token"] = self.session_token
         signed = ";".join(sorted(headers))
         # `path` arrives already percent-encoded (request() quotes once);
         # quoting again here would sign %25-escapes the wire never sends

@@ -202,6 +202,30 @@ def test_csrb_layout_roundtrip():
     assert np.all(rat[pres == 0] == 0.0)
 
 
+def test_ship_coo_narrow_dtypes_lossless():
+    """Narrow-dtype device shipping (uint16 ids / int8 half-star codes)
+    must be exactly lossless, and must fall back to full width for big
+    vocabularies or non-half-step ratings (incl. signed implicit weights)."""
+    rng = np.random.default_rng(0)
+    n = 1000
+    u = rng.integers(0, 70_000, n).astype(np.int32)     # > uint16 range
+    i = rng.integers(0, 30_000, n).astype(np.int32)     # fits uint16
+    r = (rng.integers(-10, 11, n) / 2.0).astype(np.float32)  # signed halves
+    ju, ji, jr = als._ship_coo(u, i, r, 70_000, 30_000)
+    np.testing.assert_array_equal(np.asarray(ju), u)
+    np.testing.assert_array_equal(np.asarray(ji), i)
+    np.testing.assert_array_equal(np.asarray(jr), r)
+    # arbitrary floats fall back untouched
+    r2 = rng.uniform(0, 5, n).astype(np.float32)
+    _ju, _ji, jr2 = als._ship_coo(u, i, r2, 70_000, 30_000)
+    np.testing.assert_array_equal(np.asarray(jr2), r2)
+    # boundary: id exactly 65535 fits, 65536-vocab still narrow
+    ub = np.array([0, 65_535], np.int32)
+    jub, _, _ = als._ship_coo(ub, ub, np.ones(2, np.float32), 1 << 16,
+                              1 << 16)
+    np.testing.assert_array_equal(np.asarray(jub), ub)
+
+
 def test_solve_factors_clamps_indefinite_rows():
     """Round-4 postmortem regression: kernel rounding pushed per-row Grams
     slightly indefinite and the unpivoted sweep turned a near-zero Schur
