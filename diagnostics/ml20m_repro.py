@@ -80,6 +80,13 @@ states, _ = train_rmse(kernel)
 
 if os.environ.get("REPRO_PHASE2") != "1":
     sys.exit(0)
+if IMPLICIT:
+    # phase 2 builds the EXPLICIT half-step operator (presence-weighted
+    # Gram, no YtY term); running it on implicit-trained factors would
+    # report errors for a kernel configuration production never runs
+    print("phase 2 analysis supports explicit mode only "
+          "(REPRO_IMPLICIT=1 set); stopping after phase 1", flush=True)
+    sys.exit(0)
 
 # ---- Phase 2: last finite state -> Gram comparison -------------------
 last_ok = None
