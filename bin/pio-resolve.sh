@@ -1,0 +1,16 @@
+# Shared console resolution for the bin/ scripts (sourced, not run).
+# Prefers the installed `pio` entry point (correct interpreter + installed
+# package); falls back to running the module from this source checkout
+# with python3 (stock distros ship no bare `python`).
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+if command -v pio >/dev/null 2>&1; then
+  PIO=(pio)
+else
+  PYBIN="$(command -v python3 || command -v python)"
+  if [ -z "$PYBIN" ]; then
+    echo "pio: neither an installed 'pio' entry point nor python3 found" >&2
+    exit 1
+  fi
+  export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+  PIO=("$PYBIN" -m predictionio_tpu.tools.cli)
+fi
