@@ -6,7 +6,10 @@ REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 if command -v pio >/dev/null 2>&1; then
   PIO=(pio)
 else
-  PYBIN="$(command -v python3 || command -v python)"
+  # callers source this under `set -euo pipefail`: without the `|| true`
+  # a missing python3 AND python would abort the substitution via set -e
+  # before the friendly error below could print
+  PYBIN="$(command -v python3 || command -v python || true)"
   if [ -z "$PYBIN" ]; then
     echo "pio: neither an installed 'pio' entry point nor python3 found" >&2
     exit 1

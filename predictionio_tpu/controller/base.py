@@ -126,6 +126,16 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
         """
         return [(qx, self.predict(model, q)) for qx, q in queries]
 
+    def predict_batch(self, model: M, queries: Sequence[Q]) -> List[P]:
+        """Serving-path batched predict: one coalesced micro-batch from the
+        deploy server's request batcher (serving/batcher.py), positional —
+        result i answers query i. Default maps per-query predict so every
+        engine works behind the batcher; override with a real batched
+        device kernel (the ALS templates do) to amortize dispatch. The
+        server only FORMS multi-query batches for algorithms that
+        override this (serving.protocol.batch_capable)."""
+        return [self.predict(model, q) for q in queries]
+
     # -- persistence hooks (BaseAlgorithm.makePersistentModel) --------------
     def make_persistent_model(self, ctx, instance_id: str, model: M) -> Any:
         """Return the object to persist for this model

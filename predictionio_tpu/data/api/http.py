@@ -1,9 +1,11 @@
 """Thin HTTP transport for pure route handlers.
 
 Any object with `handle(method, path, query, body, headers) -> (status,
-payload)` can be served. Threaded stdlib server — the daemons are I/O
-bound; heavy compute happens in the workflow processes, mirroring the
-reference's spray actors over a dispatcher (EventServer.scala:602-663).
+payload)` can be served; a handler may return a third element — a dict
+of extra response headers (e.g. Retry-After on a 503 from the query
+batcher's admission control). Threaded stdlib server — the daemons are
+I/O bound; heavy compute happens in the workflow processes, mirroring
+the reference's spray actors over a dispatcher (EventServer.scala:602-663).
 """
 
 from __future__ import annotations
@@ -28,9 +30,14 @@ class _Handler(BaseHTTPRequestHandler):
                                             keep_blank_values=True))
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        extra_headers = {}
         try:
-            status, payload = self.api.handle(
+            response = self.api.handle(
                 method, parsed.path, query, body, dict(self.headers.items()))
+            if len(response) == 3:
+                status, payload, extra_headers = response
+            else:
+                status, payload = response
         except Exception as e:  # handler without its own guard
             status, payload = 500, {"message": str(e)}
         if isinstance(payload, (bytes, bytearray)):  # binary (storage RPC)
@@ -54,6 +61,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(data)
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -214,10 +214,12 @@ class ECommAlgorithm(Algorithm):
         return w
 
     # ------------------------------------------------------------- serving
-    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
-        """Known users score U[u] . V; unknown users fall back to
-        similarity with their recent views — both as one masked device
-        top-K (:202-260)."""
+    def _query_plan(self, model: ECommModel, query: Query):
+        """Per-query business-rule prep shared by predict and
+        predict_batch — the LIVE event-store lookups (seen events,
+        unavailable items, recent views for unknown users) stay per query
+        in both paths. Returns (query_vec, use_hat, mask) or None for the
+        empty-result paths."""
         from predictionio_tpu.models.similarproduct.als_algorithm import (
             candidate_mask,
         )
@@ -234,13 +236,13 @@ class ECommAlgorithm(Algorithm):
         user_ix = model.user_vocab.get(query.user)
         if user_ix is not None and model.user_trained[user_ix]:
             query_vec = np.asarray(model.user_features)[user_ix]
-            factors = model.product_features
+            use_hat = False
         else:
             logger.info("No userFeature found for user %s.", query.user)
             query_vec = self._recent_views_vector(model, query.user)
             if query_vec is None:
-                return PredictedResult(())
-            factors = model.product_features_hat
+                return None
+            use_hat = True
         mask = candidate_mask(
             n_items=len(model.item_vocab),
             trained=model.item_trained,
@@ -249,7 +251,25 @@ class ECommAlgorithm(Algorithm):
             white=white, black=black, exclude=set(),
         )
         if not mask.any():
+            return None
+        return query_vec, use_hat, mask
+
+    def _rows_to_result(self, model: ECommModel, vals, idx) -> PredictedResult:
+        inv = model.item_vocab.inverse()
+        return PredictedResult(tuple(
+            ItemScore(item=inv(int(ix)), score=float(s))
+            for s, ix in zip(vals, idx) if s > 0 and np.isfinite(s)))
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        """Known users score U[u] . V; unknown users fall back to
+        similarity with their recent views — both as one masked device
+        top-K (:202-260)."""
+        plan = self._query_plan(model, query)
+        if plan is None:
             return PredictedResult(())
+        query_vec, use_hat, mask = plan
+        factors = model.product_features_hat if use_hat \
+            else model.product_features
         k = min(query.num, mask.shape[0])
         # host serving: the factor matrices are host numpy after train, and
         # one BLAS matvec + argpartition beats a per-query device dispatch
@@ -259,10 +279,44 @@ class ECommAlgorithm(Algorithm):
             else None
         vals, idx = topk.host_masked_topk(factors, query_vec, mask, k,
                                           weights=weights)
-        inv = model.item_vocab.inverse()
-        return PredictedResult(tuple(
-            ItemScore(item=inv(int(ix)), score=float(s))
-            for s, ix in zip(vals, idx) if s > 0 and np.isfinite(s)))
+        return self._rows_to_result(model, vals, idx)
+
+    def predict_batch(self, model: ECommModel,
+                      queries) -> List[PredictedResult]:
+        """Serving micro-batch: per-query business rules stay live (one
+        event-store lookup chain per query, as in predict), but the
+        scoring matvecs coalesce into one (B, rank) @ (rank, n_items)
+        matmul per factor side (known users score against raw factors,
+        unknown users against the normalized ones). weightedItems reads
+        ONE constraint snapshot per batch rather than per query — within
+        a flush every query sees the same weights, which is also the
+        stronger consistency story."""
+        queries = list(queries)
+        out: List[Optional[PredictedResult]] = [None] * len(queries)
+        weights = self._item_weights(model) if self.ap.weightedItems \
+            else None
+        groups: Dict[bool, list] = {False: [], True: []}
+        for qx, query in enumerate(queries):
+            plan = self._query_plan(model, query)
+            if plan is None:
+                out[qx] = PredictedResult(())
+            else:
+                query_vec, use_hat, mask = plan
+                groups[use_hat].append((qx, query, query_vec, mask))
+        for use_hat, group in groups.items():
+            if not group:
+                continue
+            factors = model.product_features_hat if use_hat \
+                else model.product_features
+            rows = topk.host_masked_topk_batch(
+                factors,
+                np.stack([vec for _qx, _q, vec, _m in group]),
+                [m for _qx, _q, _vec, m in group],
+                [min(q.num, m.shape[0]) for _qx, q, _vec, m in group],
+                weights=weights)
+            for (qx, _q, _vec, _m), (vals, idx) in zip(group, rows):
+                out[qx] = self._rows_to_result(model, vals, idx)
+        return out
 
     def _recent_views_vector(self, model: ECommModel,
                              user: str) -> Optional[jnp.ndarray]:

@@ -55,24 +55,27 @@ class ALSModel:
 
 
 #: one-entry process-wide device-layout cache for full-scale trains.
-#: Keyed on a CONTENT fingerprint (cheap meta tuple + crc32 over the three
-#: COO arrays): a changed event store can never reuse a stale layout, and
-#: the crc costs ~0.2 s at 20M vs ~10 s of transfer + in-HBM sorts. The
-#: crc only runs when the cheap meta prefix already matches, and is
-#: computed at most once per train (threaded from probe to store).
-_BIG_LAYOUT_CACHE: list = []   # [(meta, crc, ALSData)]
+#: Keyed on a CONTENT fingerprint (cheap meta tuple + a blake2b digest
+#: over the three COO arrays): a changed event store can never reuse a
+#: stale layout — the 128-bit digest makes a collision with identical
+#: nnz/vocab sizes cryptographically impossible (the earlier 32-bit CRC
+#: left a ~2^-32 silent-stale-layout window, ADVICE.md round 5) and
+#: still hashes at ~GB/s vs ~10 s of transfer + in-HBM sorts. The digest
+#: only runs when the cheap meta prefix already matches, and is computed
+#: at most once per train (threaded from probe to store).
+_BIG_LAYOUT_CACHE: list = []   # [(meta, digest, ALSData)]
 
 
 def _layout_meta(td, use_mesh: bool):
     return (use_mesh, td.n, len(td.user_vocab), len(td.item_vocab))
 
 
-def _layout_crc(td) -> int:
-    import zlib
-    h = 0
+def _layout_crc(td) -> bytes:
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
     for a in (td.user_idx, td.item_idx, td.rating):
-        h = zlib.crc32(np.ascontiguousarray(a).view(np.uint8), h)
-    return h
+        h.update(np.ascontiguousarray(a).view(np.uint8))
+    return h.digest()
 
 
 def _big_layout_cached(td, use_mesh: bool):
@@ -265,6 +268,52 @@ class ALSAlgorithm(Algorithm):
         return PredictedResult(tuple(
             ItemScore(item=inv(int(i)), score=float(s))
             for s, i in zip(vals, idx)))
+
+    def predict_batch(self, model: ALSModel,
+                      queries) -> List[PredictedResult]:
+        """Serving micro-batch (serving/batcher.py): stack the user-factor
+        gathers into a (B, rank) matrix, ONE (B, rank) @ (rank, n_items)
+        matmul + batched top-k for the whole batch instead of B dispatches.
+        The device path pads B up to a serving bucket so the jitted kernel
+        compiles once per bucket, never per batch size; padding rows reuse
+        index 0 (in-bounds — an OOB pad would gather NaN, KNOWN_ISSUES.md
+        #5) and are dropped before results are built."""
+        queries = list(queries)
+        out: List[Optional[PredictedResult]] = [None] * len(queries)
+        valid: List[Tuple[int, Query, int]] = []
+        for qx, q in enumerate(queries):
+            ix = model.user_vocab.get(q.user)
+            if ix is None or min(q.num, len(model.item_vocab)) <= 0:
+                out[qx] = PredictedResult(())   # same empties as predict()
+            else:
+                valid.append((qx, q, ix))
+        if not valid:
+            return out
+        k = min(max(q.num for _qx, q, _ix in valid), len(model.item_vocab))
+        ixs = np.asarray([ix for _qx, _q, ix in valid], dtype=np.int32)
+        if isinstance(model.user_factors, np.ndarray):
+            # host: one BLAS gemm for the batch, per-row argpartition with
+            # each query's own k (identical selection to predict())
+            scores = model.user_factors[ixs] @ model.item_factors.T
+            rows = [topk.host_topk(scores[r], min(q.num, k))
+                    for r, (_qx, q, _ix) in enumerate(valid)]
+        else:
+            from predictionio_tpu.serving.protocol import bucket_for
+            import jax
+
+            bucket = bucket_for(len(valid))
+            pix = np.zeros(bucket, dtype=np.int32)
+            pix[:len(valid)] = ixs
+            vals, idx = jax.device_get(topk.topk_for_users(
+                model.user_factors, model.item_factors, pix, k=k))
+            rows = [(vals[r, :min(q.num, k)], idx[r, :min(q.num, k)])
+                    for r, (_qx, q, _ix) in enumerate(valid)]
+        inv = model.item_vocab.inverse()
+        for (qx, _q, _ix), (rvals, ridx) in zip(valid, rows):
+            out[qx] = PredictedResult(tuple(
+                ItemScore(item=inv(int(i)), score=float(s))
+                for s, i in zip(rvals, ridx)))
+        return out
 
     def batch_predict(self, model: ALSModel,
                       queries: Iterable[Tuple[int, Query]]

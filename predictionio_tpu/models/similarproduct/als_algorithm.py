@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -176,19 +176,18 @@ class ALSAlgorithm(Algorithm):
                             items, len(item_vocab)))
 
     # ------------------------------------------------------------ serving
-    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        """Sum-of-cosines against the query items' vectors, filtered and
-        top-K'd on device (replaces the reference's driver-side
-        productFeatures scan, ALSAlgorithm.scala:122-212): with rows
-        pre-normalized, sum_q cos(q, v) == V_hat @ sum(q_hat)."""
+    def _plan(self, model: ALSModel, query: Query):
+        """Per-query host prep shared by predict and predict_batch: encode
+        the query items, build the sum-of-normalized-vectors query vector
+        and the candidate mask. None when no query item has a trained
+        vector (the reference's empty-result path)."""
         query_ixs = {model.item_vocab.get(i) for i in query.items}
         query_ixs.discard(None)
         query_ixs = {ix for ix in query_ixs if model.trained_mask[ix]}
         if not query_ixs:
             logger.info("No productFeatures vector for query items %s.",
                         query.items)
-            return PredictedResult(())
-
+            return None
         V_hat = np.asarray(model.product_features)
         q = np.sum(V_hat[sorted(query_ixs)], axis=0)
         mask = candidate_mask(
@@ -200,7 +199,48 @@ class ALSAlgorithm(Algorithm):
             black=self._encode_set(model, query.blackList) or set(),
             exclude=query_ixs,
         )
+        return q, mask
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        """Sum-of-cosines against the query items' vectors, filtered and
+        top-K'd on device (replaces the reference's driver-side
+        productFeatures scan, ALSAlgorithm.scala:122-212): with rows
+        pre-normalized, sum_q cos(q, v) == V_hat @ sum(q_hat)."""
+        plan = self._plan(model, query)
+        if plan is None:
+            return PredictedResult(())
+        q, mask = plan
         return topk_to_result(model, q, mask, query.num)
+
+    def predict_batch(self, model: ALSModel,
+                      queries) -> List[PredictedResult]:
+        """Serving micro-batch: the per-query matvec becomes ONE
+        (B, rank) @ (rank, n_items) BLAS matmul over the stacked query
+        vectors; masking/top-K/positive-score filtering stay per row,
+        identical to predict()'s pipeline."""
+        queries = list(queries)
+        out: List[Optional[PredictedResult]] = [None] * len(queries)
+        plans = []
+        for qx, query in enumerate(queries):
+            plan = self._plan(model, query)
+            if plan is None or not plan[1].any():
+                out[qx] = PredictedResult(())
+            else:
+                plans.append((qx, query, plan))
+        if not plans:
+            return out
+        rows = topk.host_masked_topk_batch(
+            model.product_features,
+            np.stack([q for _qx, _query, (q, _m) in plans]),
+            [m for _qx, _query, (_q, m) in plans],
+            [min(query.num, m.shape[0])
+             for _qx, query, (_q, m) in plans])
+        inv = model.item_vocab.inverse()
+        for (qx, _query, _plan), (vals, idx) in zip(plans, rows):
+            out[qx] = PredictedResult(tuple(
+                ItemScore(item=inv(int(ix)), score=float(s))
+                for s, ix in zip(vals, idx) if s > 0 and np.isfinite(s)))
+        return out
 
     @staticmethod
     def _encode_set(model: ALSModel, names) -> Optional[set]:

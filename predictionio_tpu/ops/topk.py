@@ -92,6 +92,41 @@ def topk_scores_batch(
 
 
 @partial(jax.jit, static_argnames=("k",))
+def topk_for_users(
+    user_factors: jnp.ndarray,   # (n_users, r) device-resident
+    item_factors: jnp.ndarray,   # (n_items, r) device-resident
+    user_ixs: jnp.ndarray,       # (b,) int32 — padded to a serving bucket
+    k: int = 10,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused batched serve (the micro-batcher's device hot path): B row
+    gathers + ONE (b, r) x (r, n_items) matmul + batched top_k in a single
+    dispatch — B concurrent queries cost one device round-trip instead of
+    B. Callers pad `user_ixs` up to a bucket size (serving/protocol.py)
+    with any in-bounds index (an OOB pad index would gather NaN,
+    KNOWN_ISSUES.md #5) and drop the padding rows from the result; this
+    compiles once per (bucket, k, shapes), not once per batch size."""
+    Q = jnp.take(user_factors, user_ixs, axis=0)
+    return jax.lax.top_k(Q @ item_factors.T, k)
+
+
+def host_masked_topk_batch(factors, query_vecs, masks, ks, weights=None):
+    """Batched host serving kernel: ONE (b, r) x (r, n_items) BLAS matmul
+    for the whole micro-batch, then the per-row mask/weight/argpartition
+    pipeline of host_masked_topk with each query's own k. Returns a list
+    of (vals, idx) rows. `masks` is an iterable of per-row (n_items,)
+    bool masks; `weights` an optional shared (n_items,) multiplier."""
+    import numpy as np
+
+    scores = np.asarray(query_vecs) @ np.asarray(factors).T
+    if weights is not None:
+        scores = scores * np.asarray(weights)[None, :]
+    out = []
+    for row, mask, k in zip(scores, masks, ks):
+        out.append(host_topk(np.where(np.asarray(mask), row, -np.inf), k))
+    return out
+
+
+@partial(jax.jit, static_argnames=("k",))
 def cosine_topk(
     query_vec: jnp.ndarray,
     item_factors: jnp.ndarray,

@@ -1,0 +1,20 @@
+"""Dynamic micro-batching query serving layer.
+
+The deploy server answers one query per HTTP request; on an accelerator
+the per-query cost is dominated by dispatch, not FLOPs. This package
+coalesces concurrent `/queries.json` requests into one batched device
+call (the dynamic-batching pattern from production inference servers):
+
+- :mod:`batcher` — a bounded queue that flushes on `max_batch_size` or a
+  `max_delay_ms` timer and rejects with 503 + Retry-After when saturated.
+- :mod:`protocol` — the `predict_batch(model, queries)` algorithm
+  protocol, padding-bucket selection, and the generic fall-back that
+  maps per-query `predict` so every existing engine keeps working.
+"""
+
+from predictionio_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher, ServerSaturated,
+)
+from predictionio_tpu.serving.protocol import (  # noqa: F401
+    DEFAULT_BUCKETS, batch_capable, bucket_for, pad_buckets, predict_batch,
+)

@@ -1,0 +1,187 @@
+"""Request micro-batcher with admission control.
+
+One worker thread owns a FIFO of pending items. A batch flushes when
+either `max_batch_size` items are queued or the OLDEST item has waited
+`max_delay_ms` (the timer is anchored on the head of the queue, so a
+steady trickle cannot starve the first request). The flush callback
+receives the whole batch and must return one result per item; request
+threads block on their item's completion event, so the HTTP transport's
+thread-per-connection model is preserved.
+
+Admission control: when the queue already holds `max_queue` items,
+`submit` raises :class:`ServerSaturated` instead of enqueueing — latency
+stays bounded and the caller maps it to 503 with a Retry-After hint
+derived from the observed drain rate.
+
+Stats are kept under the same condition lock (they are a handful of
+scalar updates per BATCH, not per query): batch-size and padding-bucket
+histograms, queue-wait vs flush (device) time, and rejection counts —
+surfaced by the engine server's `GET /` status route.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.serving.protocol import bucket_for, pad_buckets
+
+
+class ServerSaturated(Exception):
+    """Queue depth hit max_queue; carries the 503 Retry-After hint."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__(
+            f"serving queue saturated; retry after ~{retry_after_s}s")
+        self.retry_after_s = retry_after_s
+
+
+class _Pending:
+    __slots__ = ("item", "t_enq", "done", "result", "error")
+
+    def __init__(self, item: Any, t_enq: float):
+        self.item = item
+        self.t_enq = t_enq
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """Coalesces concurrent submit() calls into flush_fn(list) batches."""
+
+    def __init__(self, flush_fn: Callable[[List[Any]], Sequence[Any]],
+                 max_batch_size: int = 64,
+                 max_delay_ms: float = 2.0,
+                 max_queue: int = 256,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 name: str = "query-batcher"):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self._flush_fn = flush_fn
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self.buckets = pad_buckets(buckets)
+        self._cond = threading.Condition()
+        self._q: List[_Pending] = []
+        self._closed = False
+        # stats (all guarded by _cond)
+        self._batches = 0
+        self._queries = 0
+        self._rejected = 0
+        self._size_hist: Dict[int, int] = {}
+        self._bucket_hist: Dict[int, int] = {}
+        self._queue_wait_s = 0.0
+        self._flush_s = 0.0
+        self._worker = threading.Thread(
+            target=self._run, name=name, daemon=True)
+        self._worker.start()
+
+    # --------------------------------------------------------------- submit
+    def submit(self, item: Any) -> Any:
+        """Enqueue one item and block until its batch is served.
+
+        Raises ServerSaturated when the queue is full and re-raises any
+        exception the flush callback raised for this item's batch.
+        """
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._q) >= self.max_queue:
+                self._rejected += 1
+                raise ServerSaturated(self._retry_after_locked())
+            pending = _Pending(item, time.monotonic())
+            self._q.append(pending)
+            self._cond.notify_all()
+        pending.done.wait()
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def _retry_after_locked(self) -> int:
+        """Drain-time estimate for the current backlog, floored at 1s."""
+        if self._batches:
+            per_batch = self._flush_s / self._batches
+            est = (len(self._q) / self.max_batch_size + 1.0) * per_batch
+        else:
+            est = 1.0
+        return max(1, int(est + 0.999))
+
+    # --------------------------------------------------------------- worker
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait()
+                if not self._q:     # closed and drained
+                    return
+                # flush when the batch fills OR the head item's delay
+                # budget is spent; new arrivals notify and re-check
+                deadline = self._q[0].t_enq + self.max_delay_s
+                while (len(self._q) < self.max_batch_size
+                       and not self._closed):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch = self._q[:self.max_batch_size]
+                del self._q[:len(batch)]
+                now = time.monotonic()
+                self._batches += 1
+                self._queries += len(batch)
+                self._size_hist[len(batch)] = \
+                    self._size_hist.get(len(batch), 0) + 1
+                bucket = bucket_for(len(batch), self.buckets)
+                self._bucket_hist[bucket] = \
+                    self._bucket_hist.get(bucket, 0) + 1
+                self._queue_wait_s += sum(now - p.t_enq for p in batch)
+            t0 = time.monotonic()
+            try:
+                results = self._flush_fn([p.item for p in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"flush returned {len(results)} results for a "
+                        f"batch of {len(batch)}")
+                for p, r in zip(batch, results):
+                    p.result = r
+            except BaseException as e:  # propagate to every waiter
+                for p in batch:
+                    p.error = e
+            dt = time.monotonic() - t0
+            with self._cond:
+                self._flush_s += dt
+            for p in batch:
+                p.done.set()
+
+    # ---------------------------------------------------------------- admin
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting work; the worker drains the queue, then exits."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "maxBatchSize": self.max_batch_size,
+                "maxDelayMs": self.max_delay_s * 1e3,
+                "maxQueue": self.max_queue,
+                "buckets": list(self.buckets),
+                "queueDepth": len(self._q),
+                "batches": self._batches,
+                "queries": self._queries,
+                "rejected": self._rejected,
+                "batchSizeHist": {str(k): v for k, v in
+                                  sorted(self._size_hist.items())},
+                "bucketHist": {str(k): v for k, v in
+                               sorted(self._bucket_hist.items())},
+                "avgQueueWaitMs": (self._queue_wait_s / self._queries * 1e3
+                                   if self._queries else 0.0),
+                "avgFlushMs": (self._flush_s / self._batches * 1e3
+                               if self._batches else 0.0),
+            }

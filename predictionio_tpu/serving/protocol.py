@@ -1,0 +1,66 @@
+"""Batched-predict protocol + padding-bucket policy.
+
+An algorithm opts into batched serving by overriding
+``Algorithm.predict_batch(model, queries) -> [prediction]`` (see
+controller/base.py). Everything else keeps working through the generic
+fall-back that maps per-query ``predict`` — the batcher still amortizes
+HTTP/queueing, just not the device dispatch.
+
+Padding buckets: jitted batched kernels compile once per input SHAPE, so
+flushing a 3-query batch as-is would compile a (3, r) program, a 5-query
+batch a (5, r) one, and so on — an unbounded compile cache and a
+recompile stall on the latency path. Batch-capable device paths instead
+round the row count up to a small fixed set of bucket sizes and mask the
+padding rows out, so at most len(buckets) programs exist per (k, shapes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+#: default padding buckets; override per-process with PIO_SERVE_BUCKETS
+#: (comma-separated, e.g. "1,8,64").
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
+
+
+def pad_buckets(buckets: Optional[Sequence[int]] = None) -> Tuple[int, ...]:
+    """Normalized, sorted bucket tuple (explicit arg > env > default)."""
+    if buckets is None:
+        env = os.environ.get("PIO_SERVE_BUCKETS")
+        if env:
+            buckets = [int(tok) for tok in env.split(",") if tok.strip()]
+        else:
+            buckets = DEFAULT_BUCKETS
+    out = tuple(sorted({int(b) for b in buckets if int(b) >= 1}))
+    if not out:
+        raise ValueError(f"no usable padding buckets in {buckets!r}")
+    return out
+
+
+def bucket_for(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest bucket >= n; batches beyond the largest bucket compile at
+    their exact size (the batcher's max_batch_size normally caps at the
+    top bucket, so this is the overflow escape hatch, not the norm)."""
+    for b in pad_buckets(buckets):
+        if n <= b:
+            return b
+    return n
+
+
+def batch_capable(algo: Any) -> bool:
+    """True when the algorithm overrides the base predict_batch fallback
+    (i.e. has a REAL batched implementation worth forming batches for)."""
+    from predictionio_tpu.controller.base import Algorithm
+    impl = getattr(type(algo), "predict_batch", None)
+    return impl is not None and impl is not Algorithm.predict_batch
+
+
+def predict_batch(algo: Any, model: Any, queries: Sequence[Any]) -> List[Any]:
+    """Dispatch a batch through the algorithm's predict_batch (real or the
+    base fallback). Non-Algorithm doers (duck-typed engines) without the
+    method fall back to mapping predict."""
+    impl = getattr(algo, "predict_batch", None)
+    if impl is None:
+        return [algo.predict(model, q) for q in queries]
+    return list(impl(model, queries))

@@ -174,6 +174,10 @@ def cmd_deploy(args) -> int:
         event_server_ip=args.event_server_ip,
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
+        batching=args.batching,
+        batch_max_size=args.batch_max_size,
+        batch_max_delay_ms=args.batch_max_delay_ms,
+        batch_max_queue=args.batch_max_queue,
     )
     # undeploy a previous server on the same port (CreateServer.scala:260-294)
     if undeploy(args.ip, args.port):
@@ -485,6 +489,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--event-server-ip", default="localhost")
     sp.add_argument("--event-server-port", type=int, default=7070)
     sp.add_argument("--accesskey", default=None)
+    sp.add_argument("--batching", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="micro-batch concurrent queries (auto: on for "
+                         "batch-capable algorithms)")
+    sp.add_argument("--batch-max-size", type=int, default=64)
+    sp.add_argument("--batch-max-delay-ms", type=float, default=2.0)
+    sp.add_argument("--batch-max-queue", type=int, default=256,
+                    help="admission control: 503 beyond this queue depth")
 
     sp = sub.add_parser("undeploy", help="stop a deployed engine server")
     sp.add_argument("--ip", default="localhost")

@@ -41,12 +41,17 @@ from predictionio_tpu.workflow.workflow_utils import get_engine, load_object
 
 logger = logging.getLogger("predictionio_tpu.server")
 
+#: (status, payload) or (status, payload, extra_headers) — the transport
+#: (data/api/http.py) forwards the optional third element as response
+#: headers (Retry-After on 503 saturation).
 Response = Tuple[int, Any]
 
 
 @dataclasses.dataclass
 class ServerConfig:
-    """CreateServer args (CreateServer.scala:77-103)."""
+    """CreateServer args (CreateServer.scala:77-103) + micro-batching
+    knobs (serving/batcher.py; no reference analogue — the reference
+    answers strictly one query per request)."""
     engine_instance_id: Optional[str] = None
     engine_id: str = "default"
     engine_version: str = "NOT_USED"
@@ -59,6 +64,16 @@ class ServerConfig:
     event_server_port: int = 7070
     access_key: Optional[str] = None
     verbose: bool = False
+    #: "auto" batches when any algorithm has a real predict_batch
+    #: (serving.protocol.batch_capable); "on" forces the batcher even for
+    #: fallback-only engines (still amortizes queueing); "off" keeps the
+    #: original one-query-per-request path, byte for byte.
+    batching: str = "auto"
+    batch_max_size: int = 64
+    batch_max_delay_ms: float = 2.0
+    #: admission control: queue depth beyond which /queries.json answers
+    #: 503 + Retry-After instead of letting latency grow without bound.
+    batch_max_queue: int = 256
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -152,6 +167,7 @@ class QueryAPI:
         self._engine_override = engine
         self._lock = threading.Lock()
         self._stop_requested = threading.Event()
+        self._batcher = None
         # serving stats (CreateServer.scala:399-401)
         self.request_count = 0
         self.avg_serving_sec = 0.0
@@ -177,6 +193,7 @@ class QueryAPI:
             algorithms=algorithms)
         models = [a.prepare_serving(m)
                   for a, m in zip(algorithms, models)]
+        batcher = self._make_batcher(algorithms, models, serving)
         with self._lock:
             self.engine_instance = instance
             self.engine = engine
@@ -184,12 +201,58 @@ class QueryAPI:
             self.algorithms = algorithms
             self.models = models
             self.serving = serving
-        logger.info("Engine instance %s deployed (%d algorithm(s))",
-                    instance.id, len(algorithms))
+            old_batcher, self._batcher = self._batcher, batcher
+        if old_batcher is not None:   # reload: drain in-flight, then retire
+            old_batcher.close()
+        logger.info("Engine instance %s deployed (%d algorithm(s), "
+                    "batching %s)", instance.id, len(algorithms),
+                    "on" if batcher is not None else "off")
+
+    def _make_batcher(self, algorithms, models, serving):
+        """Build the request micro-batcher for this deployment, or None.
+
+        `batching: auto` (the default) engages only when some algorithm
+        has a REAL batched predict — a fallback-only engine gains nothing
+        from coalescing device work, so it keeps the inline path. The
+        flush closes over THIS load's (algorithms, models, serving): a
+        /reload swaps in a new batcher while in-flight batches finish
+        against the engine they were admitted under."""
+        from predictionio_tpu.serving import MicroBatcher, batch_capable
+        from predictionio_tpu.serving import protocol
+
+        mode = (self.config.batching or "auto").lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ServerConfig.batching must be auto/on/off, got {mode!r}")
+        if mode == "off":
+            return None
+        if mode == "auto" and not any(batch_capable(a) for a in algorithms):
+            return None
+
+        def flush(queries):
+            supplemented = [serving.supplement(q) for q in queries]
+            per_algo = [protocol.predict_batch(a, m, supplemented)
+                        for a, m in zip(algorithms, models)]
+            return [serving.serve(q, [col[j] for col in per_algo])
+                    for j, q in enumerate(queries)]
+
+        return MicroBatcher(
+            flush,
+            max_batch_size=self.config.batch_max_size,
+            max_delay_ms=self.config.batch_max_delay_ms,
+            max_queue=self.config.batch_max_queue)
 
     @property
     def stop_requested(self) -> bool:
         return self._stop_requested.is_set()
+
+    def close(self) -> None:
+        """Drain and retire the request batcher (server shutdown). Queries
+        arriving afterwards fall back to the inline single-query path."""
+        with self._lock:
+            batcher, self._batcher = self._batcher, None
+        if batcher is not None:
+            batcher.close()
 
     # ------------------------------------------------------------ dispatch
     def handle(self, method: str, path: str,
@@ -221,7 +284,7 @@ class QueryAPI:
 
     def _status(self) -> Dict[str, Any]:
         i = self.engine_instance
-        return {
+        out = {
             "status": "alive",
             "engineInstance": {
                 "id": i.id,
@@ -235,6 +298,10 @@ class QueryAPI:
             "lastServingSec": self.last_serving_sec,
             "serverStartTime": format_event_time(self.start_time),
         }
+        batcher = self._batcher
+        out["batching"] = ({"enabled": True, **batcher.stats()}
+                           if batcher is not None else {"enabled": False})
+        return out
 
     def _reload(self) -> None:
         try:
@@ -244,21 +311,33 @@ class QueryAPI:
 
     # ---------------------------------------------------------- query path
     def _queries(self, body: bytes) -> Response:
+        from predictionio_tpu.serving import ServerSaturated
         t0 = time.perf_counter()
         query_time = utcnow()
         with self._lock:
-            algorithms, models, serving = (
-                self.algorithms, self.models, self.serving)
+            algorithms, models, serving, batcher = (
+                self.algorithms, self.models, self.serving, self._batcher)
             instance = self.engine_instance
         try:
             query = json_extractor.extract_query(
                 getattr(algorithms[0], "query_class", None), body)
         except (ValueError, UnicodeDecodeError) as e:
             return 400, {"message": str(e)}
-        supplemented = serving.supplement(query)
-        predictions = [a.predict(m, supplemented)
-                       for a, m in zip(algorithms, models)]
-        prediction = serving.serve(query, predictions)
+        if batcher is not None:
+            # micro-batched path: block until this query's coalesced batch
+            # is served; concurrent requests share one device dispatch
+            try:
+                prediction = batcher.submit(query)
+            except ServerSaturated as e:
+                return 503, {"message": (
+                    "serving queue is saturated (admission control); "
+                    "retry later")}, {"Retry-After": str(e.retry_after_s)}
+        else:
+            # batching off: the original single-query path, unchanged
+            supplemented = serving.supplement(query)
+            predictions = [a.predict(m, supplemented)
+                           for a, m in zip(algorithms, models)]
+            prediction = serving.serve(query, predictions)
         result = json_extractor.to_json_obj(prediction)
 
         if self.config.feedback:
@@ -378,3 +457,4 @@ def serve(api: QueryAPI, host: str = "localhost", port: int = 8000,
     except KeyboardInterrupt:
         pass
     server.shutdown()
+    api.close()

@@ -29,6 +29,13 @@ import pytest  # noqa: E402
 from predictionio_tpu.data.storage import reset_storage, use_memory_storage  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running load/throughput tests excluded from tier-1 "
+        "(run with `-m slow`)")
+
+
 @pytest.fixture()
 def memory_storage():
     """A fresh all-in-memory Storage singleton per test."""

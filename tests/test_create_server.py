@@ -231,3 +231,317 @@ def test_http_transport_smoke(trained):
             assert len(json.loads(r.read())["itemScores"]) == 3
     finally:
         server.shutdown()
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# micro-batched serving (serving/batcher.py wired behind ServerConfig)
+# ---------------------------------------------------------------------------
+
+QUERY_SET = [{"user": f"u{k % 8}", "num": 4} for k in range(10)] + [
+    {"user": "nobody", "num": 4},      # unknown user -> empty
+    {"user": "u3", "num": 2},          # smaller k in a mixed batch
+]
+
+
+def _post(api, q):
+    return api.handle("POST", "/queries.json", body=json.dumps(q).encode())
+
+
+def test_batching_off_is_the_legacy_inline_path(trained):
+    """`batching: off` must not construct a batcher and must answer
+    byte-for-byte what the inline supplement -> predict -> serve chain
+    produces (replicated here literally)."""
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage, config=ServerConfig(batching="off"))
+    assert api._batcher is None
+    status, info = api.handle("GET", "/")
+    assert status == 200 and info["batching"] == {"enabled": False}
+    from predictionio_tpu.workflow import json_extractor
+    for q in QUERY_SET:
+        status, body = _post(api, q)
+        assert status == 200
+        query = json_extractor.extract_query(
+            api.algorithms[0].query_class, json.dumps(q).encode())
+        supplemented = api.serving.supplement(query)
+        predictions = [a.predict(m, supplemented)
+                       for a, m in zip(api.algorithms, api.models)]
+        expected = json_extractor.to_json_obj(
+            api.serving.serve(query, predictions))
+        assert json.dumps(body) == json.dumps(expected)
+
+
+def test_batched_responses_match_sequential(trained, monkeypatch):
+    """Acceptance parity: under `batching: on` (queries sent alone AND as
+    a coalesced concurrent burst, exercising different padding buckets)
+    responses are identical to the sequential single-query path."""
+    import threading
+
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")  # pin the device path
+    storage, _app_id, _iid = trained
+    api_off = QueryAPI(storage=storage, config=ServerConfig(batching="off"))
+    api_on = QueryAPI(storage=storage)     # auto -> ALS is batch-capable
+    try:
+        assert api_on._batcher is not None
+        expected = [_post(api_off, q) for q in QUERY_SET]
+
+        # one at a time through the batcher: batch=1 degenerate case
+        for q, (st_exp, body_exp) in zip(QUERY_SET, expected):
+            st, body = _post(api_on, q)
+            assert (st, json.dumps(body)) == (st_exp, json.dumps(body_exp))
+
+        # concurrent burst: queries coalesce into multi-query batches
+        results = [None] * len(QUERY_SET)
+
+        def hit(k):
+            results[k] = _post(api_on, QUERY_SET[k])
+
+        threads = [threading.Thread(target=hit, args=(k,))
+                   for k in range(len(QUERY_SET))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for (st, body), (st_exp, body_exp) in zip(results, expected):
+            assert (st, json.dumps(body)) == (st_exp, json.dumps(body_exp))
+
+        status, info = api_on.handle("GET", "/")
+        b = info["batching"]
+        assert b["enabled"] and b["queries"] == 2 * len(QUERY_SET)
+        assert b["rejected"] == 0
+        assert sum(b["batchSizeHist"].values()) == b["batches"]
+        assert b["avgFlushMs"] >= 0 and b["avgQueueWaitMs"] >= 0
+    finally:
+        api_on.close()
+        api_off.close()
+
+
+def test_bucket_padding_never_changes_results(trained, monkeypatch):
+    """predict_batch through different padding-bucket configurations must
+    return identical results (padding rows are dropped before results are
+    built), and items/ordering must match sequential predict()."""
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")  # pin the device path
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    try:
+        algo, model = api.algorithms[0], api.models[0]
+        from predictionio_tpu.models.recommendation.engine import Query
+        queries = [Query(user=f"u{k}", num=3) for k in range(3)]  # B=3
+        queries.append(Query(user="nobody", num=3))
+
+        def run(buckets):
+            monkeypatch.setenv("PIO_SERVE_BUCKETS", buckets)
+            return algo.predict_batch(model, queries)
+
+        by_bucket = {b: run(b) for b in ("4", "16", "64", "1,4,16,64")}
+        baseline = by_bucket["4"]
+        for b, res in by_bucket.items():
+            assert res == baseline, f"bucket config {b} changed results"
+        monkeypatch.delenv("PIO_SERVE_BUCKETS")
+        seq = [algo.predict(model, q) for q in queries]
+        assert baseline == seq  # device path: bitwise at this scale
+        assert baseline[3].itemScores == ()
+    finally:
+        api.close()
+
+
+def _gated_batcher(api):
+    """Wrap the deployed batcher's flush so batches block on a gate —
+    deterministic queue buildup for the admission-control tests. The
+    `entered` event proves the worker is busy inside a flush (i.e. the
+    next submits can only queue, not be picked up)."""
+    import threading
+
+    entered = threading.Event()
+    gate = threading.Event()
+    batcher = api._batcher
+    real = batcher._flush_fn
+
+    def gated(items):
+        entered.set()
+        gate.wait(30)
+        return real(items)
+
+    batcher._flush_fn = gated
+    return gate, entered
+
+
+def test_admission_control_503_retry_after(trained):
+    import threading
+
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage, config=ServerConfig(
+        batching="on", batch_max_size=1, batch_max_delay_ms=1.0,
+        batch_max_queue=2))
+    gate, entered = _gated_batcher(api)
+    try:
+        threads = [threading.Thread(
+            target=_post, args=(api, {"user": "u1", "num": 2}))]
+        threads[0].start()
+        assert entered.wait(10)    # worker provably busy in a flush
+        for _ in range(2):         # fill the queue to max_queue
+            t = threading.Thread(
+                target=_post, args=(api, {"user": "u1", "num": 2}))
+            t.start()
+            threads.append(t)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with api._batcher._cond:
+                if len(api._batcher._q) >= 2:
+                    break
+            time.sleep(0.01)
+        response = _post(api, {"user": "u1", "num": 2})
+        assert len(response) == 3
+        status, body, headers = response
+        assert status == 503 and "saturated" in body["message"]
+        assert int(headers["Retry-After"]) >= 1
+        gate.set()
+        for t in threads:
+            t.join(30)
+        status, info = api.handle("GET", "/")
+        assert info["batching"]["rejected"] == 1
+    finally:
+        gate.set()
+        api.close()
+
+
+def test_admission_control_503_over_http(trained):
+    """The transport forwards the 3-tuple's Retry-After header."""
+    import threading
+
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage, config=ServerConfig(
+        batching="on", batch_max_size=1, batch_max_delay_ms=1.0,
+        batch_max_queue=1))
+    gate, entered = _gated_batcher(api)
+    server, port = serve_background(api)
+    try:
+        def post_http():
+            req = urllib.request.Request(
+                f"http://localhost:{port}/queries.json",
+                data=json.dumps({"user": "u1", "num": 2}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as r:
+                return r.status
+
+        threads = [threading.Thread(target=post_http)]
+        threads[0].start()
+        assert entered.wait(10)    # worker provably busy in a flush
+        threads.append(threading.Thread(target=post_http))
+        threads[1].start()         # fills the 1-slot queue
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with api._batcher._cond:
+                if len(api._batcher._q) >= 1:
+                    break
+            time.sleep(0.01)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_http()
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        gate.set()
+        for t in threads:
+            t.join(30)
+    finally:
+        gate.set()
+        server.shutdown()
+        api.close()
+
+
+def test_concurrent_burst_smoke(trained):
+    """Tier-1 smoke: a 4-query concurrent burst through the batcher over
+    real HTTP on CPU — every response correct, stats consistent."""
+    import threading
+
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    assert api._batcher is not None      # auto: ALS is batch-capable
+    server, port = serve_background(api)
+    try:
+        out = [None] * 4
+
+        def post_http(k):
+            req = urllib.request.Request(
+                f"http://localhost:{port}/queries.json",
+                data=json.dumps({"user": f"u{k}", "num": 3}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as r:
+                out[k] = (r.status, json.loads(r.read()))
+
+        threads = [threading.Thread(target=post_http, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for status, body in out:
+            assert status == 200 and len(body["itemScores"]) == 3
+        _, info = api.handle("GET", "/")
+        assert info["requestCount"] == 4
+        b = info["batching"]
+        assert b["queries"] == 4 and b["rejected"] == 0
+        assert sum(b["batchSizeHist"].values()) == b["batches"] <= 4
+    finally:
+        server.shutdown()
+        api.close()
+
+
+def test_reload_swaps_batcher(trained):
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    first = api._batcher
+    assert first is not None
+    api._reload()           # synchronous variant of POST /reload
+    assert api._batcher is not None and api._batcher is not first
+    assert first._closed    # retired batcher was drained and closed
+    status, body = _post(api, {"user": "u1", "num": 2})
+    assert status == 200 and len(body["itemScores"]) == 2
+    api.close()
+
+
+@pytest.mark.slow
+def test_concurrent_load_throughput(trained):
+    """Sustained concurrent load through the batcher: 16 keep-alive
+    clients x 25 queries, no rejects, everything coalesces correctly."""
+    import http.client
+    import threading
+
+    storage, _app_id, _iid = trained
+    api = QueryAPI(storage=storage)
+    server, port = serve_background(api)
+    n_clients, per_client = 16, 25
+    errors = []
+    try:
+        def client(cx):
+            try:
+                conn = http.client.HTTPConnection("localhost", port)
+                for q in range(per_client):
+                    conn.request(
+                        "POST", "/queries.json",
+                        body=json.dumps(
+                            {"user": f"u{(cx + q) % 8}", "num": 4}),
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    body = json.loads(resp.read())
+                    assert resp.status == 200, body
+                    assert len(body["itemScores"]) == 4
+                conn.close()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(cx,))
+                   for cx in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors[:3]
+        _, info = api.handle("GET", "/")
+        b = info["batching"]
+        assert b["queries"] == n_clients * per_client
+        assert b["rejected"] == 0
+        # concurrency must actually coalesce: fewer batches than queries
+        assert b["batches"] < b["queries"]
+    finally:
+        server.shutdown()
+        api.close()

@@ -37,6 +37,21 @@ def _ev(name, user, item, props=None, minute=0, hour=1):
         event_time=dt.datetime(2021, 1, 1, hour, minute % 60, tzinfo=UTC))
 
 
+def _assert_batch_matches_sequential(seq, bat):
+    """Batched serving parity: same items in the same order; scores equal
+    up to the last-bit difference between one BLAS gemm row and a gemv
+    (the batched path's only numerical deviation)."""
+    import numpy as np
+
+    assert len(seq) == len(bat)
+    for a, b in zip(seq, bat):
+        assert [s.item for s in a.itemScores] == \
+            [s.item for s in b.itemScores]
+        np.testing.assert_allclose(
+            [s.score for s in a.itemScores],
+            [s.score for s in b.itemScores], rtol=1e-5, atol=1e-7)
+
+
 # ---------------------------------------------------------------------------
 # classification
 # ---------------------------------------------------------------------------
@@ -180,6 +195,25 @@ class TestSimilarProduct:
         res = algo.predict(model, Query(items=("nope",), num=3))
         assert res.itemScores == ()
 
+    def test_predict_batch_matches_sequential(self, memory_storage, app):
+        """Serving micro-batch (one gemm over stacked query vectors) must
+        agree with per-query predict across the full filter surface,
+        including the empty paths."""
+        from predictionio_tpu.models.similarproduct import Query
+        algo, model, _td = self._train(memory_storage)
+        queries = [
+            Query(items=("i0",), num=2),
+            Query(items=("i0",), num=4, categories=("odd",)),
+            Query(items=("nope",), num=3),              # unknown -> empty
+            Query(items=("i0", "i2", "i4"), num=6),
+            Query(items=("i1",), num=3, blackList=("i3",)),
+            Query(items=("i0",), num=4, whiteList=("i2",)),
+        ]
+        seq = [algo.predict(model, q) for q in queries]
+        bat = algo.predict_batch(model, queries)
+        _assert_batch_matches_sequential(seq, bat)
+        assert bat[2].itemScores == ()
+
     def test_like_algorithm_latest_wins(self, memory_storage, app):
         algo, model, td = self._train(memory_storage, algo_name="likealgo")
         # u0 i1: like at 2:58 then dislike at 3:59 -> rating -1
@@ -307,6 +341,34 @@ class TestECommerce:
         # reference parity: recently-viewed items stay candidates
         # (predictNewUser has no recentList exclusion), so i0 may rank first
         assert {s.item for s in res.itemScores} <= {"i0", "i2", "i4"}
+
+    def test_predict_batch_matches_sequential(self, memory_storage, app):
+        """One mixed micro-batch covering both scoring groups — known
+        users (raw factors) and a recent-views fallback user (normalized
+        factors) — plus the live business-rule filters and an empty
+        path, vs per-query predict."""
+        from predictionio_tpu.models.ecommerce import Query
+        algo, model, _td = self._train(memory_storage)
+        store.write([_ev("view", "newbie", "i0", minute=1, hour=5)],
+                    app, storage=memory_storage)
+        store.write([Event(
+            event="$set", entity_type="constraint",
+            entity_id="unavailableItems",
+            properties=DataMap({"items": ["i3"]}),
+            event_time=dt.datetime(2021, 1, 2, tzinfo=UTC))],
+            app, storage=memory_storage)
+        queries = [
+            Query(user="u1", num=3),
+            Query(user="u2", num=4, categories=("even",)),
+            Query(user="newbie", num=3),             # hat-factors group
+            Query(user="ghost", num=3),              # no events -> empty
+            Query(user="u0", num=6, blackList=("i5",)),
+        ]
+        seq = [algo.predict(model, q) for q in queries]
+        bat = algo.predict_batch(model, queries)
+        _assert_batch_matches_sequential(seq, bat)
+        assert bat[3].itemScores == ()
+        assert all("i3" not in {s.item for s in r.itemScores} for r in bat)
         # unknown user with no history -> empty
         res = algo.predict(model, Query(user="ghost", num=2))
         assert res.itemScores == ()
