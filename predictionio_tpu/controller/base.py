@@ -126,6 +126,16 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
         """
         return [(qx, self.predict(model, q)) for qx, q in queries]
 
+    def prepare_layout(self, ctx, prepared_data: PD) -> None:
+        """Optional pre-train hook: build (and cache) any data-dependent
+        device layout for `prepared_data` that is shared across
+        hyperparameter variants. The eval-grid workflow
+        (workflow/fast_eval.py) calls this once per fold BEFORE the
+        per-variant loop so rank-compatible variants reuse one layout
+        instead of each rebuilding it; ALS overrides it with the COO
+        sort layout. Default: no layout to prepare."""
+        return None
+
     def predict_batch(self, model: M, queries: Sequence[Q]) -> List[P]:
         """Serving-path batched predict: one coalesced micro-batch from the
         deploy server's request batcher (serving/batcher.py), positional —

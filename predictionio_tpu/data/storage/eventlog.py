@@ -84,6 +84,28 @@ _FLUSH_AT = 1 << 16  # buffered events per (app, channel) before compaction
 _MAX_EXACT_INT = 1 << 53  # beyond float64 exactness -> JSON side-channel
 
 
+def _read_thread_count(explicit: Optional[int] = None) -> int:
+    """Decode-worker count for bulk columnar reads.
+
+    Priority: explicit argument (``pio train --read-threads``) >
+    ``PIO_READ_THREADS`` env > min(8, cores). 1 disables the pool and
+    decodes chunks serially in the calling thread — exactly the
+    pre-parallel behavior."""
+    if explicit is None:
+        raw = os.environ.get("PIO_READ_THREADS", "")
+        try:
+            explicit = int(raw) if raw else 0
+        except ValueError:
+            explicit = 0
+    if explicit and explicit > 0:
+        return explicit
+    try:
+        cores = len(os.sched_getaffinity(0))   # cgroup-aware
+    except AttributeError:   # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    return max(1, min(8, cores))
+
+
 class StorageClient:
     """Directory holder (config PATH, default $PIO_FS_BASEDIR/eventlog)."""
 
@@ -1039,6 +1061,200 @@ class EventlogEvents(Events):
             return iter(matches)
 
     # -- bulk columnar read (the TPU ingestion path) -------------------------
+    def _decode_chunk_columns(
+        self,
+        sh: _Shard,
+        seq: int,
+        ev_codes: Optional[List[int]],
+        et_code: Optional[int],
+        tt_code: Optional[int],
+        tomb_rows: Optional[List[int]],
+        rating_property: str,
+    ) -> Dict[str, np.ndarray]:
+        """Decode + filter one immutable chunk into bulk-read columns.
+
+        Runs WITHOUT the shard lock (chunk files never change after
+        publication); safe to execute on any number of worker threads.
+        String-typed ratings are coerced from the JSON side-channel exactly
+        like the generic object path's float(); the extras offsets come
+        from the chunk's cached column dict when the serving LRU already
+        holds it (``__extra_offsets__`` is precomputed there) instead of
+        re-running the cumsum over the whole chunk per read."""
+        nc = "nc_" + rating_property
+        with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
+            mask = np.ones(data["event"].shape[0], dtype=bool)
+            if ev_codes is not None:
+                mask &= np.isin(data["event"], ev_codes)
+            if et_code is not None:
+                mask &= data["entity_type"] == et_code
+            if tt_code is not None:
+                mask &= data["target_type"] == tt_code
+            if tomb_rows:
+                mask[np.asarray(tomb_rows, dtype=np.int64)] = False
+            if nc in data.files:
+                r = data[nc][mask].astype(np.float32)
+            else:
+                r = np.full(int(mask.sum()), np.nan, np.float32)
+            # string-typed ratings live in the JSON side-channel; decode
+            # is bounded by how many rows are actually dirty
+            dirty = np.isnan(r) & (data["extra_len"][mask] > 0)
+            if dirty.any():
+                cached = sh.col_cache.get(seq)   # peek only: no LRU reorder
+                offsets = _extra_offsets(
+                    cached if cached is not None
+                    else {"extra_len": np.asarray(data["extra_len"])})
+                lengths = data["extra_len"]
+                blob = str(data["extra_blob"])
+                rows = np.nonzero(mask)[0][dirty]
+                for out_ix, row in zip(np.nonzero(dirty)[0], rows):
+                    raw = blob[offsets[row]: offsets[row] + lengths[row]]
+                    try:
+                        v = json.loads(raw).get("p", {}).get(
+                            rating_property)
+                        if v is not None:
+                            r[out_ix] = float(v)
+                    except (ValueError, TypeError):
+                        pass
+            return {
+                "entity_code": data["entity_id"][mask],
+                "target_code": data["target_id"][mask],
+                "event_code": data["event"][mask],
+                "rating": r,
+                "time_ms": data["time_ms"][mask],
+            }
+
+    @staticmethod
+    def _encode_buffer_tail(
+        buffer: List[Event],
+        codes_get,
+        token: str,
+        next_seq: int,
+        tombstones: set,
+        event_names: Optional[Sequence[str]],
+        entity_type: Optional[str],
+        target_entity_type: Optional[str],
+        rating_property: str,
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Encode the unflushed rows (ours or the writer's WAL tail) as one
+        pseudo-chunk; None when nothing matches."""
+        ent, tgt, evt, rat, tms = [], [], [], [], []
+        for row, e in enumerate(buffer):
+            eid = f"{token}-{next_seq}-{row}"
+            if eid in tombstones:
+                continue
+            if event_names is not None and e.event not in event_names:
+                continue
+            if entity_type is not None and e.entity_type != entity_type:
+                continue
+            if (target_entity_type is not None
+                    and e.target_entity_type != target_entity_type):
+                continue
+            ent.append(codes_get(e.entity_id, -1))
+            tgt.append(codes_get(e.target_entity_id, -1)
+                       if e.target_entity_id is not None else -1)
+            evt.append(codes_get(e.event, -1))
+            tms.append(_millis(e.event_time))
+            v = e.properties.get_opt(rating_property)
+            try:
+                rat.append(float(v) if v is not None else np.nan)
+            except (TypeError, ValueError):
+                rat.append(np.nan)
+        if not ent:
+            return None
+        return {
+            "entity_code": np.asarray(ent, np.int32),
+            "target_code": np.asarray(tgt, np.int32),
+            "event_code": np.asarray(evt, np.int32),
+            "rating": np.asarray(rat, np.float32),
+            "time_ms": np.asarray(tms, np.int64),
+        }
+
+    def read_columns_streamed(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        rating_property: str = "rating",
+        read_threads: Optional[int] = None,
+    ) -> Tuple[List[str], Iterator[Dict[str, np.ndarray]]]:
+        """Bulk read as ``(pool, chunk iterator)`` — the streaming twin of
+        :meth:`read_columns` that lets callers overlap downstream work
+        (vocab encode, host→HBM staging) with chunk decode.
+
+        Each yielded item is a dict of per-chunk column arrays
+        (entity_code / target_code / event_code / rating / time_ms), in
+        chunk-seq order, with the unflushed tail last — concatenating them
+        reproduces :meth:`read_columns` byte for byte regardless of the
+        worker count. Chunks decode on a thread pool (``read_threads``
+        argument > ``PIO_READ_THREADS`` env > min(8, cores); 1 = serial
+        in-line decode, today's exact behavior).
+
+        Locking: the shard lock is held only for the dict/WAL refresh and
+        a state snapshot (chunk list, buffer copy, tombstones, filter
+        codes), so concurrent ingest into the same shard proceeds while a
+        multi-second scan is in flight. Chunks are immutable once
+        published, so decode needs no lock; the snapshot gives the read
+        point-in-time semantics (rows inserted after the snapshot are not
+        seen, never double-counted). Concurrent `remove()` of the whole
+        shard during a read remains undefined (as for any reader).
+        """
+        with self._lock:
+            sh = self._shard(app_id, channel_id)
+            self._refresh(sh)
+            pool = list(sh.pool)
+            seqs = sh.chunk_seqs()
+            buffer = list(sh.buffer)
+            next_seq = sh.next_seq
+            token = sh.token
+            tombstones = set(sh.tombstones)
+            ev_codes = ([sh.codes[nm] for nm in event_names
+                         if nm in sh.codes]
+                        if event_names is not None else None)
+            et_code = (sh.codes.get(entity_type, -2)
+                       if entity_type is not None else None)
+            tt_code = (sh.codes.get(target_entity_type, -2)
+                       if target_entity_type is not None else None)
+        # the dictionary is append-only, so the live .get resolves the
+        # snapshot's strings to the same codes forever (no copy needed)
+        codes_get = sh.codes.get
+        tomb_by_seq: Dict[int, List[int]] = {}
+        for t in tombstones:
+            try:
+                tok, seq_s, row_s = t.split("-", 2)
+                if tok == token:
+                    tomb_by_seq.setdefault(int(seq_s), []).append(int(row_s))
+            except ValueError:
+                continue
+
+        def chunks() -> Iterator[Dict[str, np.ndarray]]:
+            n_threads = _read_thread_count(read_threads)
+            if n_threads > 1 and len(seqs) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(
+                        max_workers=min(n_threads, len(seqs)),
+                        thread_name_prefix="pio-read") as pool_:
+                    futs = [pool_.submit(
+                        self._decode_chunk_columns, sh, seq, ev_codes,
+                        et_code, tt_code, tomb_by_seq.get(seq),
+                        rating_property) for seq in seqs]
+                    for f in futs:     # seq order preserved for parity
+                        yield f.result()
+            else:
+                for seq in seqs:
+                    yield self._decode_chunk_columns(
+                        sh, seq, ev_codes, et_code, tt_code,
+                        tomb_by_seq.get(seq), rating_property)
+            tail = self._encode_buffer_tail(
+                buffer, codes_get, token, next_seq, tombstones,
+                event_names, entity_type, target_entity_type,
+                rating_property)
+            if tail is not None:
+                yield tail
+
+        return pool, chunks()
+
     def read_columns(
         self,
         app_id: int,
@@ -1047,6 +1263,7 @@ class EventlogEvents(Events):
         entity_type: Optional[str] = None,
         target_entity_type: Optional[str] = None,
         rating_property: str = "rating",
+        read_threads: Optional[int] = None,
     ) -> Dict[str, object]:
         """Bulk load matching events as code arrays + the string pool.
 
@@ -1054,102 +1271,27 @@ class EventlogEvents(Events):
         event_code (int32 arrays), rating (float32, NaN where the property
         is absent), time_ms (int64). No per-event Python objects for chunk
         rows — this is the `PEventStore.find → HBM` path at full numpy
-        bandwidth. Unflushed (WAL) rows are encoded on the fly; string
-        ratings (client quirk, e.g. "4.5") are coerced from the JSON
-        side-channel exactly like the generic object path does.
+        bandwidth. Chunks decode in parallel (see
+        :meth:`read_columns_streamed` for the threading/locking story);
+        the result is byte-identical at any worker count, and
+        ``PIO_READ_THREADS=1`` reproduces the serial path exactly.
         """
-        with self._lock:
-            sh = self._shard(app_id, channel_id)
-            self._refresh(sh)
-            ent, tgt, evt, rat, tms = [], [], [], [], []
-            nc = "nc_" + rating_property
-            for seq in sh.chunk_seqs():
-                with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
-                    mask = np.ones(data["event"].shape[0], dtype=bool)
-                    if event_names is not None:
-                        codes = [sh.codes[nm] for nm in event_names
-                                 if nm in sh.codes]
-                        mask &= np.isin(data["event"], codes)
-                    if entity_type is not None:
-                        mask &= (data["entity_type"]
-                                 == sh.codes.get(entity_type, -2))
-                    if target_entity_type is not None:
-                        mask &= (data["target_type"]
-                                 == sh.codes.get(target_entity_type, -2))
-                    if sh.tombstones:
-                        parsed = (self._parse_id(sh, t)
-                                  for t in sh.tombstones)
-                        tomb_rows = [p[1] for p in parsed
-                                     if p is not None and p[0] == seq]
-                        if tomb_rows:
-                            mask[np.asarray(tomb_rows,
-                                            dtype=np.int64)] = False
-                    ent.append(data["entity_id"][mask])
-                    tgt.append(data["target_id"][mask])
-                    evt.append(data["event"][mask])
-                    tms.append(data["time_ms"][mask])
-                    if nc in data.files:
-                        r = data[nc][mask].astype(np.float32)
-                    else:
-                        r = np.full(int(mask.sum()), np.nan, np.float32)
-                    # string-typed ratings live in the JSON side-channel;
-                    # coerce them like the object path's float() (bounded
-                    # by how many rows are actually dirty)
-                    dirty = np.isnan(r) & (data["extra_len"][mask] > 0)
-                    if dirty.any():
-                        lengths = data["extra_len"]
-                        offsets = np.concatenate(
-                            [[0], np.cumsum(lengths)[:-1]])
-                        blob = str(data["extra_blob"])
-                        rows = np.nonzero(mask)[0][dirty]
-                        for out_ix, row in zip(np.nonzero(dirty)[0], rows):
-                            raw = blob[offsets[row]:
-                                       offsets[row] + lengths[row]]
-                            try:
-                                v = json.loads(raw).get("p", {}).get(
-                                    rating_property)
-                                if v is not None:
-                                    r[out_ix] = float(v)
-                            except (ValueError, TypeError):
-                                pass
-                    rat.append(r)
-            # unflushed rows (ours or the writer's WAL tail)
-            if sh.buffer:
-                for row, e in enumerate(sh.buffer):
-                    eid = f"{sh.token}-{sh.next_seq}-{row}"
-                    if eid in sh.tombstones:
-                        continue
-                    if event_names is not None and e.event not in event_names:
-                        continue
-                    if (entity_type is not None
-                            and e.entity_type != entity_type):
-                        continue
-                    if (target_entity_type is not None
-                            and e.target_entity_type != target_entity_type):
-                        continue
-                    ent.append(np.asarray(
-                        [sh.codes.get(e.entity_id, -1)], np.int32))
-                    tgt.append(np.asarray(
-                        [sh.codes.get(e.target_entity_id, -1)
-                         if e.target_entity_id is not None else -1],
-                        np.int32))
-                    evt.append(np.asarray(
-                        [sh.codes.get(e.event, -1)], np.int32))
-                    tms.append(np.asarray([_millis(e.event_time)], np.int64))
-                    v = e.properties.get_opt(rating_property)
-                    try:
-                        rat.append(np.asarray(
-                            [float(v) if v is not None else np.nan],
-                            np.float32))
-                    except (TypeError, ValueError):
-                        rat.append(np.asarray([np.nan], np.float32))
-            cat = (lambda xs, d: np.concatenate(xs) if xs
-                   else np.empty(0, dtype=d))
-            return {
-                "pool": list(sh.pool),
-                "entity_code": cat(ent, np.int32),
-                "target_code": cat(tgt, np.int32),
-                "event_code": cat(evt, np.int32),
-                "rating": cat(rat, np.float32),
-                "time_ms": cat(tms, np.int64),
-            }
+        pool, parts_iter = self.read_columns_streamed(
+            app_id, channel_id, event_names=event_names,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            rating_property=rating_property, read_threads=read_threads)
+        parts = list(parts_iter)
+
+        def cat(key: str, dtype) -> np.ndarray:
+            xs = [p[key] for p in parts]
+            return np.concatenate(xs) if xs else np.empty(0, dtype=dtype)
+
+        return {
+            "pool": pool,
+            "entity_code": cat("entity_code", np.int32),
+            "target_code": cat("target_code", np.int32),
+            "event_code": cat("event_code", np.int32),
+            "rating": cat("rating", np.float32),
+            "time_ms": cat("time_ms", np.int64),
+        }

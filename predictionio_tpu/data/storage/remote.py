@@ -271,12 +271,21 @@ class StorageRPCAPI:
         if not hasattr(ev, "read_columns"):
             raise ValueError(
                 "backing event store has no columnar bulk-read support")
+        kw = {}
+        if a.get("read_threads"):
+            # client-requested decode parallelism (pio train
+            # --read-threads against a storage server); only forwarded to
+            # backends that understand it
+            import inspect
+            if "read_threads" in inspect.signature(
+                    ev.read_columns).parameters:
+                kw["read_threads"] = int(a["read_threads"])
         cols = ev.read_columns(
             a["app_id"], a.get("channel_id"),
             event_names=a.get("event_names"),
             entity_type=a.get("entity_type"),
             target_entity_type=a.get("target_entity_type"),
-            rating_property=a.get("rating_property", "rating"))
+            rating_property=a.get("rating_property", "rating"), **kw)
         arrays = {
             "entity_code": np.ascontiguousarray(cols["entity_code"],
                                                 dtype=np.int32),
@@ -579,11 +588,14 @@ class RemoteEvents(Events):
 
     def read_columns(self, app_id, channel_id=None, event_names=None,
                      entity_type=None, target_entity_type=None,
-                     rating_property: str = "rating"):
+                     rating_property: str = "rating", read_threads=None):
         """Columnar bulk read over the binary "PIOC" route — the
         store-server twin of eventlog.read_columns, so store.find_columnar
         takes the vectorized path against a `remote` EVENTDATA source too.
-        Arrays come back as zero-copy np.frombuffer views of the reply."""
+        Arrays come back as zero-copy np.frombuffer views of the reply.
+        `read_threads` is a decode-parallelism hint forwarded to the
+        server's backing store (eventlog chunks decode on a thread pool
+        server-side; the server's own PIO_READ_THREADS is the default)."""
         import struct
 
         import numpy as np
@@ -593,7 +605,8 @@ class RemoteEvents(Events):
             "event_names": list(event_names) if event_names else None,
             "entity_type": entity_type,
             "target_entity_type": target_entity_type,
-            "rating_property": rating_property}).encode()
+            "rating_property": rating_property,
+            "read_threads": read_threads}).encode()
         status, payload = self.c.request_raw(
             "POST", "/rpc/read_columns", body, retry=True)
         if (status == 400 and b"columnar" in payload) or status == 404:

@@ -225,6 +225,105 @@ class SqliteEvents(_Sqlite, base.Events):
         rows = self._query(" ".join(sql), tuple(params))
         return (Event.from_json(r[0], validate=False) for r in rows)
 
+    def read_columns(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        rating_property: str = "rating",
+        read_threads: Optional[int] = None,
+    ) -> Dict[str, object]:
+        """Columnar bulk read (the eventlog.read_columns contract): one
+        C-level SQL scan of the indexed filter columns + `json_extract` of
+        the rating property, encoded against a synthesized string pool —
+        so `pio train` against the sqlite backend takes
+        store.find_columnar's vectorized path instead of materializing an
+        Event object per row, and `pio storageserver` over sqlite serves
+        the binary columnar RPC route. The pool is the sorted distinct
+        strings of this result set (dense-vocab assignment downstream
+        treats ids as opaque). String-typed ratings ("4.5") coerce like
+        the object path's float(); absent/NaN-able values become NaN.
+        `read_threads` is accepted for interface parity — the scan is a
+        single query, there are no chunks to parallelize."""
+        import numpy as np
+
+        sel = ("SELECT entity_id, target_entity_id, event, event_time_ms, "
+               "{rating} FROM events WHERE app_id=? AND channel_id=?")
+        where: List[str] = []
+        params: list = [app_id, _ck(channel_id)]
+        if event_names is not None:
+            if not event_names:
+                rows: list = []
+                where = None
+            else:
+                where.append(
+                    "AND event IN (%s)" % ",".join("?" * len(event_names)))
+                params.extend(event_names)
+        if where is not None:
+            if entity_type is not None:
+                where.append("AND entity_type = ?")
+                params.append(entity_type)
+            if target_entity_type is not None:
+                where.append("AND target_entity_type = ?")
+                params.append(target_entity_type)
+            tail = " ".join([""] + where) if where else ""
+            # json_extract path parameterization only survives simple
+            # property names; anything else falls back to doc parsing
+            import re
+            simple = re.fullmatch(r"[A-Za-z0-9_\-]+", rating_property)
+            rows = None
+            if simple:
+                try:
+                    rows = self._query(
+                        sel.format(rating="json_extract(doc, ?)") + tail,
+                        tuple([f"$.properties.{rating_property}"]
+                              + params))
+                except sqlite3.OperationalError:
+                    rows = None      # sqlite built without JSON1
+            if rows is None:
+                raw = self._query(sel.format(rating="doc") + tail,
+                                  tuple(params))
+                rows = []
+                for ent, tgt, evt, tms, doc in raw:
+                    try:
+                        v = (json.loads(doc).get("properties") or {}).get(
+                            rating_property)
+                    except ValueError:
+                        v = None
+                    rows.append((ent, tgt, evt, tms, v))
+
+        n = len(rows)
+        rat = np.full(n, np.nan, np.float32)
+        tms = np.empty(n, np.int64)
+        strings = set()
+        for j, (ent, tgt, evt, t, v) in enumerate(rows):
+            tms[j] = t
+            strings.add(ent)
+            strings.add(evt)
+            if tgt is not None:
+                strings.add(tgt)
+            if v is not None:
+                try:
+                    rat[j] = float(v)
+                except (TypeError, ValueError):
+                    pass
+        pool = sorted(strings)
+        code = {s: c for c, s in enumerate(pool)}
+        return {
+            "pool": pool,
+            "entity_code": np.fromiter(
+                (code[r[0]] for r in rows), np.int32, n),
+            "target_code": np.fromiter(
+                (code[r[1]] if r[1] is not None else -1 for r in rows),
+                np.int32, n),
+            "event_code": np.fromiter(
+                (code[r[2]] for r in rows), np.int32, n),
+            "rating": rat,
+            "time_ms": tms,
+        }
+
 
 class SqliteApps(_Sqlite, base.Apps):
     def _create_tables(self):

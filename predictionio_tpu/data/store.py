@@ -209,6 +209,11 @@ class ColumnarEvents:
     event_name_idx: np.ndarray   # (n,) int32
     rating: np.ndarray           # (n,) float32, NaN when property absent
     event_time_ms: np.ndarray    # (n,) int64 epoch millis
+    #: optional device-resident mirrors of the encoded arrays
+    #: (ops/staging.StagedColumns), populated by the overlapped read path
+    #: when the caller asked for staging — value-identical to the host
+    #: arrays above, already in HBM so the ALS layout skips its transfer
+    staged: Optional[object] = None
 
     @property
     def n(self) -> int:
@@ -218,12 +223,22 @@ class ColumnarEvents:
 def _columnar_from_codes(cols: Dict[str, object],
                          event_names: Optional[Sequence[str]],
                          entity_vocab: Optional[BiMap],
-                         target_vocab: Optional[BiMap]) -> ColumnarEvents:
+                         target_vocab: Optional[BiMap],
+                         presence: Optional[Dict[str, np.ndarray]] = None,
+                         luts_out: Optional[Dict[str, object]] = None,
+                         ) -> ColumnarEvents:
     """Vectorized dict-code → dense-vocab encode (zero per-event Python).
 
     Vocab ids are assigned in dictionary-code order (≈ first-ingested order)
     rather than the object path's first-matching-event order; downstream
     kernels treat ids as opaque, so only the BiMap contents matter.
+
+    `presence`, when given, carries pool-presence masks precomputed
+    incrementally by the streamed read path ("entity"/"target" bool arrays
+    over the pool) so that work overlapped chunk decode instead of running
+    here. `luts_out`, when given, receives the dense LUTs + whether every
+    row was kept — the device-staging finalize needs them to replay the
+    identical remap in HBM.
     """
     pool: List[str] = cols["pool"]  # type: ignore[assignment]
     ecode = np.asarray(cols["entity_code"])
@@ -232,12 +247,13 @@ def _columnar_from_codes(cols: Dict[str, object],
     rating = np.asarray(cols["rating"])
     tms = np.asarray(cols["time_ms"])
 
-    def dense(codes, vocab):
+    def dense(codes, vocab, present):
         valid = codes >= 0  # -1 = event has no such entity (targets)
         if vocab is None:
-            # presence via bincount + LUT gather: O(n + pool), no sort
-            present = np.bincount(
-                codes[valid], minlength=len(pool)).astype(bool)
+            if present is None:
+                # presence via bincount + LUT gather: O(n + pool), no sort
+                present = np.bincount(
+                    codes[valid], minlength=len(pool)).astype(bool)
             used = np.nonzero(present)[0]
             lut = np.full(len(pool), -1, np.int32)
             lut[used] = np.arange(used.size, dtype=np.int32)
@@ -245,7 +261,7 @@ def _columnar_from_codes(cols: Dict[str, object],
                                for c in used.tolist()})
             idx = np.where(valid, lut[np.maximum(codes, 0)],
                            -1).astype(np.int32)
-            return idx, out_vocab, np.ones(codes.shape[0], dtype=bool)
+            return idx, out_vocab, np.ones(codes.shape[0], dtype=bool), lut
         lut = np.full(len(pool), -1, np.int32)
         str2code = {s: c for c, s in enumerate(pool)}
         for s, i in vocab.to_dict().items():
@@ -255,12 +271,16 @@ def _columnar_from_codes(cols: Dict[str, object],
         idx = np.where(valid, lut[np.maximum(codes, 0)], -1).astype(np.int32)
         # fixed vocab: drop events referencing unseen (non-null) entities
         keep = ~(valid & (idx < 0))
-        return idx, vocab, keep
+        return idx, vocab, keep, lut
 
-    e_idx, e_vocab, e_keep = dense(ecode, entity_vocab)
-    t_idx, t_vocab, t_keep = dense(tcode, target_vocab)
+    presence = presence or {}
+    e_idx, e_vocab, e_keep, e_lut = dense(
+        ecode, entity_vocab, presence.get("entity"))
+    t_idx, t_vocab, t_keep, t_lut = dense(
+        tcode, target_vocab, presence.get("target"))
     keep = e_keep & t_keep
-    if not keep.all():
+    kept_all = bool(keep.all())
+    if not kept_all:
         e_idx, t_idx, ncode = e_idx[keep], t_idx[keep], ncode[keep]
         rating, tms = rating[keep], tms[keep]
 
@@ -274,12 +294,93 @@ def _columnar_from_codes(cols: Dict[str, object],
             name_lut[pool.index(n)] = i
         except ValueError:
             pass
+    if luts_out is not None:
+        luts_out.update(e_lut=e_lut, t_lut=t_lut, name_lut=name_lut,
+                        kept_all=kept_all)
     return ColumnarEvents(
         entity_ids=e_vocab, target_ids=t_vocab, event_names=name_order,
         entity_idx=e_idx, target_idx=t_idx,
         event_name_idx=name_lut[ncode].astype(np.int32),
         rating=rating.astype(np.float32), event_time_ms=tms.astype(np.int64),
     )
+
+
+def _overlap_enabled() -> bool:
+    """PIO_READ_OVERLAP=0 turns the streamed decode∥encode pipeline off
+    (the read then runs read→encode strictly in sequence, as before)."""
+    import os
+    return os.environ.get("PIO_READ_OVERLAP", "1") != "0"
+
+
+def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
+                            entity_type, target_entity_type, rating_property,
+                            entity_vocab, target_vocab, stage, timings):
+    """Overlapped bulk read: consume per-chunk column arrays as decode
+    workers finish, folding the vocab-presence pass (and, when staging is
+    on, the host→HBM transfer of each chunk) into the decode wall-clock
+    instead of after it. Byte-identical output to the non-streamed path.
+
+    Timing split: read_io = time spent waiting on chunk decode;
+    read_encode = per-chunk accumulation + the final dense remap."""
+    pool, chunks = events_dao.read_columns_streamed(
+        app_id, channel_id, event_names=event_names,
+        entity_type=entity_type, target_entity_type=target_entity_type,
+        rating_property=rating_property)
+    stager = None
+    if stage and entity_vocab is None and target_vocab is None:
+        from predictionio_tpu.ops import staging as _staging
+        if _staging.staging_available():
+            stager = _staging.ColumnStager()
+    parts = []
+    e_present = (np.zeros(len(pool), dtype=bool)
+                 if entity_vocab is None else None)
+    t_present = (np.zeros(len(pool), dtype=bool)
+                 if target_vocab is None else None)
+    io_s = 0.0
+    t_mark = _time.perf_counter()
+    for ch in chunks:
+        now = _time.perf_counter()
+        io_s += now - t_mark
+        parts.append(ch)
+        # vocab-presence accumulates per chunk WHILE later chunks decode
+        if e_present is not None:
+            ec = ch["entity_code"]
+            e_present[ec[ec >= 0]] = True
+        if t_present is not None:
+            tc = ch["target_code"]
+            t_present[tc[tc >= 0]] = True
+        if stager is not None:
+            stager.add(ch)      # async host→HBM copy rides the decode
+        t_mark = _time.perf_counter()
+    t1 = _time.perf_counter()
+
+    def cat(key, dtype):
+        xs = [p[key] for p in parts]
+        return np.concatenate(xs) if xs else np.empty(0, dtype=dtype)
+
+    cols = {
+        "pool": pool,
+        "entity_code": cat("entity_code", np.int32),
+        "target_code": cat("target_code", np.int32),
+        "event_code": cat("event_code", np.int32),
+        "rating": cat("rating", np.float32),
+        "time_ms": cat("time_ms", np.int64),
+    }
+    presence = {}
+    if e_present is not None:
+        presence["entity"] = e_present
+    if t_present is not None:
+        presence["target"] = t_present
+    luts: Dict[str, object] = {}
+    out = _columnar_from_codes(cols, event_names, entity_vocab, target_vocab,
+                               presence=presence, luts_out=luts)
+    if stager is not None and luts.get("kept_all"):
+        out.staged = stager.finalize(luts["e_lut"], luts["t_lut"],
+                                     luts["name_lut"])
+    if timings is not None:
+        timings["read_io"] = io_s
+        timings["read_encode"] = _time.perf_counter() - t1
+    return out
 
 
 def find_columnar(
@@ -293,12 +394,20 @@ def find_columnar(
     target_vocab: Optional[BiMap] = None,
     storage: Optional[Storage] = None,
     timings: Optional[Dict[str, float]] = None,
+    stage: bool = False,
 ) -> ColumnarEvents:
     """Single-pass events → columnar buffers + vocabs.
 
     `timings`, when given, receives {"read_io": s, "read_encode": s} on the
     columnar fast path (store scan vs vocab-encode split — the bench
-    reports these as read sub-phases).
+    reports these as read sub-phases; under the overlapped pipeline,
+    read_io is the time actually spent *waiting* on chunk decode).
+
+    `stage=True` additionally asks for device-resident mirrors of the
+    encoded arrays (`ColumnarEvents.staged`, ops/staging.py): each chunk is
+    `device_put` while later chunks are still decoding, so the host→HBM
+    COO transfer overlaps the read instead of following it. Only engaged
+    when both vocabs grow (no rows dropped) and `PIO_READ_STAGE` != 0.
 
     This replaces the reference's full Spark job for `BiMap.stringInt`
     (BiMap.scala:96-128) plus the per-template `.map`/`.filter` RDD chains:
@@ -307,11 +416,20 @@ def find_columnar(
 
     When the event store is the columnar event log
     (data/storage/eventlog.py) the whole read runs vectorized over
-    dictionary codes — no Event objects, no JSON — at numpy bandwidth;
-    otherwise it falls back to the generic per-event path.
+    dictionary codes — no Event objects, no JSON — with chunks decoding on
+    a thread pool (PIO_READ_THREADS); otherwise it falls back to the
+    generic per-event path. The remote driver's read is one binary RPC
+    (no local streaming), but the storage *server* decodes its chunks in
+    parallel the same way.
     """
     storage = storage or get_storage()
     events_dao = storage.get_events()
+    if hasattr(events_dao, "read_columns_streamed") and _overlap_enabled():
+        app_id, channel_id = _resolve_app(app_name, channel_name, storage)
+        return _find_columnar_streamed(
+            events_dao, app_id, channel_id, event_names, entity_type,
+            target_entity_type, rating_property, entity_vocab, target_vocab,
+            stage, timings)
     if hasattr(events_dao, "read_columns"):
         app_id, channel_id = _resolve_app(app_name, channel_name, storage)
         t0 = _time.perf_counter()

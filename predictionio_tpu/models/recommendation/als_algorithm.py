@@ -97,6 +97,89 @@ def _big_layout_store(td, use_mesh: bool, data, crc=None) -> None:
         _BIG_LAYOUT_CACHE[:] = [(_layout_meta(td, use_mesh), crc, data)]
 
 
+#: layout-reuse instrumentation: hits = a train (or prepare_layout) served
+#: its device layout from either cache tier; builds = prepare_ratings ran.
+#: The bench's eval-grid leg reports the delta as `eval_grid_reuse_hits`.
+LAYOUT_STATS = {"hits": 0, "builds": 0}
+
+
+def staging_wanted() -> bool:
+    """Should the bulk read stage its COO chunks to device while decoding?
+
+    Yes unless a process-wide big-layout entry exists that an unchanged
+    event store would hit — a warm retrain must skip the host→HBM transfer
+    entirely, not overlap it. (PIO_READ_STAGE=0 kills staging outright in
+    ops/staging.py; this gate only spares warm runs the wasted copy.)"""
+    from predictionio_tpu.ops.staging import staging_available
+    if not staging_available():
+        return False
+    return not (als._layout_cache_enabled() and _BIG_LAYOUT_CACHE)
+
+
+def _ensure_layout(ctx, td, use_mesh: bool):
+    """The device-side COO layout for one TrainingData, through both cache
+    tiers (train's "layout" phase body, shared with prepare_layout).
+
+    The COO layout is rank-independent, so an eval grid's variants sharing
+    one fold (FastEval memoizes the PreparedData object) reuse it instead
+    of re-sorting the same ratings per variant. Eval-scale data caches on
+    the TrainingData object; FULL-scale data (td.n > 2M) caches ONE entry
+    process-wide keyed on a content fingerprint, so repeat trains over an
+    unchanged event store (the bench's slope passes; retrain-on-deploy)
+    skip the transfer + in-HBM sorts entirely. The retained HBM (~0.5 GB
+    at 20M) is bounded at one entry; PIO_ALS_LAYOUT_CACHE=0 disables
+    retention."""
+    import os
+    cacheable = td.n <= int(os.environ.get(
+        "PIO_ALS_BIG_LAYOUT_MIN", 2_000_000))
+    cache_key = ("als_layout", use_mesh)
+    cached = getattr(td, "_pio_layout_cache", None) \
+        if cacheable else None
+    big_crc = None
+    if cached is not None and cached[0] == cache_key:
+        data = cached[1]
+    else:
+        data, big_crc = _big_layout_cached(td, use_mesh)
+    if data is not None:
+        LAYOUT_STATS["hits"] += 1
+        return data
+    LAYOUT_STATS["builds"] += 1
+    if not cacheable:
+        # evict stale entries BEFORE building the replacement: holding the
+        # old device layout + hybrid prep across the rebuild would
+        # transiently double retained HBM
+        _BIG_LAYOUT_CACHE.clear()
+        als._HYBRID_CACHE.clear()
+    # the overlapped read may have pre-staged the encoded COO in HBM
+    # (ops/staging.py rides it on the TrainingData); the staged arrays are
+    # value-identical to the host columns, so prepare_ratings consumes
+    # them directly and skips its own host shipping
+    staged = getattr(td, "_staged_coo", None) if not use_mesh else None
+    if staged is not None and int(staged[0].shape[0]) == td.n:
+        u_in, i_in, r_in = staged
+    else:
+        u_in, i_in, r_in = td.user_idx, td.item_idx, td.rating
+    data = als.prepare_ratings(
+        u_in, i_in, r_in,
+        n_users=len(td.user_vocab), n_items=len(td.item_vocab),
+        # single-device: sort/pad in HBM; mesh path re-partitions on host
+        device=not use_mesh)
+    if not isinstance(data.by_user.self_idx, np.ndarray):
+        # tunneled platforms (axon) can return from block_until_ready
+        # before results land; fetching one element forces the in-HBM
+        # sort so the layout phase owns its wall-clock instead of
+        # leaking into train
+        import jax
+
+        jax.device_get((data.by_user.self_idx[-1:],
+                        data.by_item.self_idx[-1:]))
+    if cacheable:
+        td._pio_layout_cache = (cache_key, data)
+    else:
+        _big_layout_store(td, use_mesh, data, crc=big_crc)
+    return data
+
+
 class ALSAlgorithm(Algorithm):
     params_class = ALSAlgorithmParams
     query_class = Query
@@ -122,53 +205,7 @@ class ALSAlgorithm(Algorithm):
             import contextlib
             layout = contextlib.nullcontext()
         with layout:
-            # the COO layout is rank-independent, so an eval grid's variants
-            # sharing one fold (FastEval memoizes the PreparedData object)
-            # reuse it instead of re-sorting the same ratings per variant.
-            # Eval-scale data caches on the TrainingData object; FULL-scale
-            # data (td.n > 2M) caches ONE entry process-wide keyed on a
-            # content fingerprint, so repeat trains over an unchanged event
-            # store (the bench's slope passes; retrain-on-deploy) skip the
-            # transfer + in-HBM sorts entirely. The retained HBM (~0.5 GB
-            # at 20M) is bounded at one entry; PIO_ALS_LAYOUT_CACHE=0
-            # disables retention.
-            import os
-            cacheable = td.n <= int(os.environ.get(
-                "PIO_ALS_BIG_LAYOUT_MIN", 2_000_000))
-            cache_key = ("als_layout", use_mesh)
-            cached = getattr(td, "_pio_layout_cache", None) \
-                if cacheable else None
-            big_crc = None
-            if cached is not None and cached[0] == cache_key:
-                data = cached[1]
-            else:
-                data, big_crc = _big_layout_cached(td, use_mesh)
-            if data is None:
-                if not cacheable:
-                    # evict stale entries BEFORE building the replacement:
-                    # holding the old device layout + hybrid prep across
-                    # the rebuild would transiently double retained HBM
-                    _BIG_LAYOUT_CACHE.clear()
-                    als._HYBRID_CACHE.clear()
-                data = als.prepare_ratings(
-                    td.user_idx, td.item_idx, td.rating,
-                    n_users=len(td.user_vocab), n_items=len(td.item_vocab),
-                    # single-device: sort/pad in HBM; mesh path
-                    # re-partitions on host
-                    device=not use_mesh)
-                if not isinstance(data.by_user.self_idx, np.ndarray):
-                    # tunneled platforms (axon) can return from
-                    # block_until_ready before results land; fetching one
-                    # element forces the in-HBM sort so the layout phase
-                    # owns its wall-clock instead of leaking into train
-                    import jax
-
-                    jax.device_get((data.by_user.self_idx[-1:],
-                                    data.by_item.self_idx[-1:]))
-                if cacheable:
-                    td._pio_layout_cache = (cache_key, data)
-                else:
-                    _big_layout_store(td, use_mesh, data, crc=big_crc)
+            data = _ensure_layout(ctx, td, use_mesh)
         checkpointer = None
         ckpt_dir = getattr(ctx, "checkpoint_dir", None)
         if self.ap.checkpointInterval and ckpt_dir:
@@ -198,6 +235,22 @@ class ALSAlgorithm(Algorithm):
         return ALSModel(
             rank=self.ap.rank, user_factors=U, item_factors=V,
             user_vocab=td.user_vocab, item_vocab=td.item_vocab)
+
+    def prepare_layout(self, ctx, prepared: PreparedData) -> None:
+        """Eval-grid hoist (workflow/fast_eval.py): build — or reuse — the
+        device COO layout for this fold's ratings BEFORE any variant
+        trains. The layout is rank-independent, so one prepare_layout per
+        fold serves every rank/iteration variant of the grid; subsequent
+        train() calls hit the TrainingData-object cache."""
+        td = prepared.ratings
+        if td.n == 0:
+            return
+        use_mesh = ctx is not None and getattr(ctx, "mesh", None) is not None
+        if ctx is not None and hasattr(ctx, "phase"):
+            with ctx.phase("layout"):
+                _ensure_layout(ctx, td, use_mesh)
+        else:
+            _ensure_layout(ctx, td, use_mesh)
 
     def prepare_serving(self, model: ALSModel) -> ALSModel:
         """Pick the serving path by MEASURING the deployed device.

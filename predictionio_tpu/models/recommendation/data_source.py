@@ -73,8 +73,16 @@ def training_data_from_columnar(col) -> TrainingData:
     """Columnar rate/buy events → TrainingData: buy maps to BUY_RATING
     regardless of properties (DataSource.scala:57-59), a rate event with no
     numeric rating is an error (:62-68). Shared by this template and the
-    example variants (entitymap / sliding-eval datasources)."""
+    example variants (entitymap / sliding-eval datasources).
+
+    When the overlapped read staged device mirrors of the columns
+    (`col.staged`, ops/staging.py), the same buy→rating mapping is applied
+    on device and the resulting (user, item, rating) device COO rides the
+    TrainingData as `_staged_coo`, letting the ALS layout skip its own
+    host→HBM transfer. The host arrays below stay the source of truth
+    (sanity checks, fingerprints, eval folds all use them)."""
     rating = col.rating.copy()
+    buy_code = None
     if "buy" in col.event_names:
         buy_code = col.event_names.index("buy")
         rating[col.event_name_idx == buy_code] = BUY_RATING
@@ -83,10 +91,14 @@ def training_data_from_columnar(col) -> TrainingData:
         raise ValueError(
             f"{bad} rate event(s) have no numeric 'rating' property — "
             "cannot convert to Rating (DataSource.scala:62-68 behavior)")
-    return TrainingData(
+    td = TrainingData(
         user_idx=col.entity_idx, item_idx=col.target_idx, rating=rating,
         user_vocab=col.entity_ids, item_vocab=col.target_ids,
     )
+    staged = getattr(col, "staged", None)
+    if staged is not None and staged.n == td.n:
+        td._staged_coo = staged.training_view(buy_code, BUY_RATING)
+    return td
 
 
 class DataSource(BaseDataSource):
@@ -98,6 +110,7 @@ class DataSource(BaseDataSource):
     def _get_ratings(self, ctx,
                      entity_vocab=None, target_vocab=None) -> TrainingData:
         timings: Dict[str, float] = {}
+        from predictionio_tpu.models.recommendation import als_algorithm
         col = store.find_columnar(
             self.dsp.appName,
             entity_type="user",
@@ -108,6 +121,10 @@ class DataSource(BaseDataSource):
             target_vocab=target_vocab,
             storage=ctx.storage,
             timings=timings,
+            # overlap the host→HBM COO transfer with chunk decode, but only
+            # when a layout rebuild is plausible (a warm retrain whose
+            # content-fingerprint cache will hit must not pay the transfer)
+            stage=als_algorithm.staging_wanted(),
         )
         # sub-phase visibility: store scan vs vocab-encode inside "read"
         sink = getattr(ctx, "phase_seconds", None)

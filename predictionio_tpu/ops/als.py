@@ -148,8 +148,31 @@ def prepare_ratings(
     raw COO to the device once and does both sorted layouts there with XLA
     variadic sorts — the single-device trainers consume the resulting
     jax arrays with zero further host work, so `pio train` ETL is one
-    240MB-at-20M transfer plus two in-HBM sorts.
+    240MB-at-20M transfer plus two in-HBM sorts. device=True also accepts
+    jax arrays already resident in HBM (the overlapped read's staging
+    buffers, ops/staging.py): the transfer was overlapped with chunk
+    decode upstream, so the narrow-dtype host shipping is skipped and the
+    in-HBM sorts run on identical values — layouts match the host path
+    bit for bit.
     """
+    if device and isinstance(user_idx, jax.Array):
+        nnz = int(user_idx.shape[0])
+        nnz_pad = bucket_units(max(-(-nnz // chunk), 1)) * chunk
+        u = user_idx.astype(jnp.int32)
+        i = item_idx.astype(jnp.int32)
+        r = rating.astype(jnp.float32)
+
+        def side_staged(a, b, n_a, n_b) -> COOSide:
+            s, o, rr, counts = _side_device(a, b, r, n_a, nnz_pad)
+            return COOSide(self_idx=s, other_idx=o, rating=rr,
+                           counts=counts, n_self=n_a, n_other=n_b)
+
+        return ALSData(
+            by_user=side_staged(u, i, n_users, n_items),
+            by_item=side_staged(i, u, n_items, n_users),
+            n_users=n_users, n_items=n_items, nnz=nnz,
+        )
+
     user_idx = np.asarray(user_idx, dtype=np.int32)
     item_idx = np.asarray(item_idx, dtype=np.int32)
     rating = np.asarray(rating, dtype=np.float32)

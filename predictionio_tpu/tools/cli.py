@@ -96,7 +96,20 @@ def _make_context(batch: str = "", devices: int = 0,
         mesh=mesh)
 
 
+def _apply_read_env(args) -> None:
+    """Map the train read-pipeline flags onto their env knobs (the storage
+    layer reads PIO_READ_THREADS / PIO_READ_OVERLAP so library callers and
+    the storage server honor the same switches)."""
+    if getattr(args, "read_threads", 0):
+        os.environ["PIO_READ_THREADS"] = str(args.read_threads)
+    overlap = getattr(args, "read_overlap", "")
+    if overlap:
+        os.environ["PIO_READ_OVERLAP"] = "1" if overlap == "on" else "0"
+        os.environ["PIO_READ_STAGE"] = "1" if overlap == "on" else "0"
+
+
 def cmd_train(args) -> int:
+    _apply_read_env(args)
     if getattr(args, "coordinator", ""):
         if args.num_processes < 1:
             _error("--coordinator requires --num-processes >= 1")
@@ -471,6 +484,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="this host's rank in [0, --num-processes)")
     sp.add_argument("--profile", default="",
                     help="write a jax.profiler trace to this directory")
+    sp.add_argument("--read-threads", type=int, default=0,
+                    help="parallel chunk-decode workers for the bulk event "
+                         "read (default: PIO_READ_THREADS or min(8, "
+                         "cores); 1 = serial, the pre-parallel behavior)")
+    sp.add_argument("--read-overlap", choices=("on", "off"), default="",
+                    help="overlap chunk decode with vocab-encode and "
+                         "host->HBM staging (default on; sets "
+                         "PIO_READ_OVERLAP / PIO_READ_STAGE)")
 
     sp = sub.add_parser("eval", help="run an evaluation")
     sp.add_argument("evaluation_class")

@@ -161,6 +161,10 @@ def run_evaluation(
         batch=ctx.workflow_params.batch, env=dict(ctx.runtime_env)))
     try:
         workflow = FastEvalEngineWorkflow(evaluation.engine, ctx)
+        # hoist the data read + device-side layout out of the per-variant
+        # loop: one read + one layout per (data-source, preparator) prefix
+        # and fold; rank-compatible variants below reuse them
+        workflow.prepare_shared_layouts(engine_params_list)
         engine_eval_data_sets = [
             (ep, workflow.eval(ep)) for ep in engine_params_list]
         evaluator = evaluation.evaluator

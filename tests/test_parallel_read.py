@@ -1,0 +1,375 @@
+"""Parallel, overlapped bulk-read pipeline (ISSUE 2).
+
+The chunk decode of eventlog.read_columns runs on a thread pool and the
+shard lock shrinks to the refresh + snapshot — so:
+
+- results must be BYTE-identical at any worker count (tombstones, a WAL
+  tail, and string-coded ratings included), with PIO_READ_THREADS=1
+  reproducing the serial path exactly;
+- concurrent ingest into the same shard must proceed (and neither side
+  corrupt) while a multi-second scan is in flight;
+- the device-staged mirrors (ops/staging.py) must match the host columns
+  bit for bit and train to identical factors;
+- the eval grid must build each fold's device layout once, shared across
+  rank-compatible variants (fast_eval.prepare_shared_layouts).
+"""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import store
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.data.storage import eventlog as el_mod
+
+UTC = dt.timezone.utc
+
+COLS = ("entity_code", "target_code", "event_code", "rating", "time_ms")
+
+
+def el_storage(tmp_path):
+    s = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    app_id = s.get_meta_data_apps().insert(App(0, "app"))
+    s.get_events().init(app_id)
+    return s, app_id
+
+
+def seed_messy_store(tmp_path, monkeypatch, n=240, flush_at=60):
+    """Multi-chunk store with buy events, string-coded ratings, a WAL tail
+    and tombstones in both a chunk and the tail."""
+    monkeypatch.setattr(el_mod, "_FLUSH_AT", flush_at)
+    s, app_id = el_storage(tmp_path)
+    ev = s.get_events()
+    rng = np.random.default_rng(0)
+    evs = []
+    for j in range(n):
+        name = "buy" if j % 5 == 0 else "rate"
+        if name == "buy":
+            props = {}
+        elif j % 7 == 0:
+            props = {"rating": f"{rng.integers(1, 10) / 2}"}  # string-coded
+        else:
+            props = {"rating": float(rng.integers(2, 11) / 2)}
+        evs.append(Event(
+            event=name, entity_type="user", entity_id=f"u{j % 17}",
+            target_entity_type="item", target_entity_id=f"i{j % 11}",
+            properties=DataMap(props),
+            event_time=dt.datetime(2021, 1, 1, tzinfo=UTC)
+            + dt.timedelta(seconds=j)))
+    ids = []
+    for lo in range(0, n, flush_at):     # one chunk per batch
+        ids.extend(ev.insert_batch(evs[lo:lo + flush_at], app_id))
+    tail = [Event(event="rate", entity_type="user", entity_id=f"u{k}",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": "3.5"}))
+            for k in range(5)]
+    tail_ids = ev.insert_batch(tail, app_id)   # unflushed WAL tail
+    ev.delete(ids[3], app_id)        # tombstone in a chunk
+    ev.delete(tail_ids[2], app_id)   # tombstone in the tail
+    sh = ev._shard(app_id, None)
+    assert len(sh.chunk_seqs()) >= 3 and sh.buffer
+    return s, app_id
+
+
+def test_parallel_read_byte_identical(tmp_path, monkeypatch):
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    ev = s.get_events()
+    kw = dict(event_names=["rate", "buy"], entity_type="user",
+              target_entity_type="item")
+    serial = ev.read_columns(app_id, read_threads=1, **kw)
+    for threads in (2, 4, 7):
+        par = ev.read_columns(app_id, read_threads=threads, **kw)
+        assert par["pool"] == serial["pool"]
+        for k in COLS:
+            assert par[k].tobytes() == serial[k].tobytes(), (threads, k)
+    # env knob routes the same way as the argument
+    monkeypatch.setenv("PIO_READ_THREADS", "3")
+    par = ev.read_columns(app_id, **kw)
+    for k in COLS:
+        assert par[k].tobytes() == serial[k].tobytes()
+    # string-coded + tail ratings actually got coerced (not NaN-dropped)
+    assert np.isfinite(serial["rating"]).sum() > 0
+    n_rate = int((serial["rating"] == 3.5).sum())
+    assert n_rate >= 4   # the 5 tail events minus 1 tombstone contribute
+
+
+def test_streamed_chunks_concatenate_to_read_columns(tmp_path, monkeypatch):
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    ev = s.get_events()
+    whole = ev.read_columns(app_id, event_names=["rate"])
+    pool, chunks = ev.read_columns_streamed(app_id, event_names=["rate"],
+                                            read_threads=4)
+    parts = list(chunks)
+    assert pool == whole["pool"]
+    for k in COLS:
+        got = (np.concatenate([p[k] for p in parts]) if parts
+               else np.empty(0))
+        assert got.tobytes() == whole[k].tobytes()
+
+
+def test_insert_during_long_read_no_deadlock(tmp_path, monkeypatch):
+    """The shard lock is released during chunk decode: an insert landing
+    mid-scan completes promptly, the in-flight read returns its snapshot,
+    and a follow-up read sees the new row."""
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    ev = s.get_events()
+    pre = ev.read_columns(app_id, event_names=["rate", "buy"])
+
+    started, release = threading.Event(), threading.Event()
+    orig = el_mod.EventlogEvents._decode_chunk_columns
+
+    def slow_decode(self, sh, seq, *a, **kw):
+        started.set()
+        assert release.wait(timeout=10), "reader stuck waiting for release"
+        return orig(self, sh, seq, *a, **kw)
+
+    monkeypatch.setattr(el_mod.EventlogEvents, "_decode_chunk_columns",
+                        slow_decode)
+    result = {}
+
+    def reader():
+        result["cols"] = ev.read_columns(app_id,
+                                         event_names=["rate", "buy"])
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    assert started.wait(timeout=10), "read never reached chunk decode"
+
+    ins_done = threading.Event()
+
+    def insert():
+        ev.insert(Event(event="rate", entity_type="user",
+                        entity_id="u-mid-read",
+                        target_entity_type="item", target_entity_id="i0",
+                        properties=DataMap({"rating": 5.0})), app_id)
+        ins_done.set()
+
+    it = threading.Thread(target=insert)
+    it.start()
+    # the insert must NOT have to wait for the multi-chunk scan
+    assert ins_done.wait(timeout=10), \
+        "insert blocked behind an in-flight bulk read"
+    release.set()
+    rt.join(timeout=30)
+    it.join(timeout=5)
+    assert not rt.is_alive()
+    # the in-flight read returned its pre-insert snapshot, uncorrupted
+    for k in COLS:
+        assert result["cols"][k].tobytes() == pre[k].tobytes()
+    monkeypatch.setattr(el_mod.EventlogEvents, "_decode_chunk_columns", orig)
+    post = ev.read_columns(app_id, event_names=["rate", "buy"])
+    assert post["rating"].shape[0] == pre["rating"].shape[0] + 1
+    assert "u-mid-read" in post["pool"]
+
+
+def test_overlap_off_matches_overlap_on(tmp_path, monkeypatch):
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    kw = dict(event_names=["rate", "buy"], entity_type="user",
+              target_entity_type="item", storage=s)
+    monkeypatch.setenv("PIO_READ_OVERLAP", "0")
+    off = store.find_columnar("app", **kw)
+    monkeypatch.setenv("PIO_READ_OVERLAP", "1")
+    on = store.find_columnar("app", **kw)
+    for attr in ("entity_idx", "target_idx", "event_name_idx", "rating",
+                 "event_time_ms"):
+        assert getattr(on, attr).tobytes() == getattr(off, attr).tobytes()
+    assert on.entity_ids.to_dict() == off.entity_ids.to_dict()
+    assert on.target_ids.to_dict() == off.target_ids.to_dict()
+    assert on.event_names == off.event_names
+
+
+def test_staged_mirrors_match_host_columns(tmp_path, monkeypatch):
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    col = store.find_columnar(
+        "app", event_names=["rate", "buy"], entity_type="user",
+        target_entity_type="item", storage=s, stage=True)
+    assert col.staged is not None and col.staged.n == col.n
+    np.testing.assert_array_equal(np.asarray(col.staged.entity_idx),
+                                  col.entity_idx)
+    np.testing.assert_array_equal(np.asarray(col.staged.target_idx),
+                                  col.target_idx)
+    np.testing.assert_array_equal(np.asarray(col.staged.event_name_idx),
+                                  col.event_name_idx)
+    assert np.asarray(col.staged.rating).tobytes() == col.rating.tobytes()
+    # the template's device-side buy mapping mirrors the host one
+    from predictionio_tpu.models.recommendation.data_source import (
+        training_data_from_columnar,
+    )
+    td = training_data_from_columnar(col)
+    u_d, i_d, r_d = td._staged_coo
+    np.testing.assert_array_equal(np.asarray(u_d), td.user_idx)
+    np.testing.assert_array_equal(np.asarray(i_d), td.item_idx)
+    assert np.asarray(r_d).tobytes() == td.rating.tobytes()
+
+
+def test_staged_and_host_train_identically(tmp_path, monkeypatch):
+    from predictionio_tpu.models.recommendation.als_algorithm import (
+        ALSAlgorithm, ALSAlgorithmParams,
+    )
+    from predictionio_tpu.models.recommendation.data_source import (
+        training_data_from_columnar,
+    )
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    kw = dict(event_names=["rate", "buy"], entity_type="user",
+              target_entity_type="item", storage=s)
+    td_staged = training_data_from_columnar(
+        store.find_columnar("app", stage=True, **kw))
+    td_host = training_data_from_columnar(
+        store.find_columnar("app", stage=False, **kw))
+    assert hasattr(td_staged, "_staged_coo")
+    assert not hasattr(td_host, "_staged_coo")
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=3, numIterations=2, seed=7))
+    m_staged = algo.train(None, type("P", (), {"ratings": td_staged})())
+    m_host = algo.train(None, type("P", (), {"ratings": td_host})())
+    np.testing.assert_array_equal(np.asarray(m_staged.user_factors),
+                                  np.asarray(m_host.user_factors))
+    np.testing.assert_array_equal(np.asarray(m_staged.item_factors),
+                                  np.asarray(m_host.item_factors))
+
+
+def test_stage_kill_switch(tmp_path, monkeypatch):
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    monkeypatch.setenv("PIO_READ_STAGE", "0")
+    col = store.find_columnar(
+        "app", event_names=["rate", "buy"], entity_type="user",
+        target_entity_type="item", storage=s, stage=True)
+    assert col.staged is None
+
+
+def test_staging_wanted_skips_warm_retrain(monkeypatch):
+    from predictionio_tpu.models.recommendation import als_algorithm
+    monkeypatch.setattr(als_algorithm, "_BIG_LAYOUT_CACHE", [])
+    assert als_algorithm.staging_wanted()
+    # a populated content-fingerprint cache means a warm retrain is likely
+    # to hit — don't pay the staged transfer
+    monkeypatch.setattr(als_algorithm, "_BIG_LAYOUT_CACHE",
+                        [("meta", b"crc", object())])
+    assert not als_algorithm.staging_wanted()
+    monkeypatch.setenv("PIO_ALS_LAYOUT_CACHE", "0")
+    assert als_algorithm.staging_wanted()   # cache disabled -> cold rebuild
+    monkeypatch.setenv("PIO_READ_STAGE", "0")
+    assert not als_algorithm.staging_wanted()
+
+
+def test_sqlite_columnar_matches_object_path(tmp_path):
+    """sqlite's new read_columns: find_columnar's vectorized path must
+    agree with the per-event path event for event (same treatment as the
+    eventlog, ISSUE 2 tentpole pt. 1 'sqlite/remote backends')."""
+    from tests.test_eventlog_ingestion import seed_events, triples
+
+    sql_env = {
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "pio.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    }
+    s_sql = Storage(env=sql_env)
+    app_id = s_sql.get_meta_data_apps().insert(App(0, "app"))
+    s_sql.get_events().init(app_id)
+    mem_env = {
+        "PIO_STORAGE_SOURCES_T_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "T",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T",
+    }
+    s_mem = Storage(env=mem_env)
+    s_mem.get_meta_data_apps().insert(App(0, "app"))
+
+    rng = np.random.default_rng(3)
+    evs = seed_events(rng)
+    evs.append(Event(event="rate", entity_type="user", entity_id="u1",
+                     target_entity_type="item", target_entity_id="i1",
+                     properties=DataMap({"rating": "4.5"}),   # string-coded
+                     event_time=dt.datetime(2021, 1, 3, tzinfo=UTC)))
+    s_sql.get_events().insert_batch(evs, app_id)
+    s_mem.get_events().insert_batch(evs, 1)
+
+    assert hasattr(s_sql.get_events(), "read_columns")
+    kw = dict(event_names=["rate", "buy"], entity_type="user",
+              target_entity_type="item")
+    fast = store.find_columnar("app", storage=s_sql, **kw)
+    slow = store.find_columnar("app", storage=s_mem, **kw)
+    assert fast.n == slow.n
+    assert triples(fast) == triples(slow)
+    assert set(fast.entity_ids.to_dict()) == set(slow.entity_ids.to_dict())
+    assert set(fast.target_ids.to_dict()) == set(slow.target_ids.to_dict())
+    # no-target events survive as -1 codes through the raw contract
+    raw = s_sql.get_events().read_columns(app_id)
+    assert (raw["target_code"] == -1).sum() == 3   # the $set events
+
+
+def test_eval_grid_builds_layout_once_per_fold(memory_storage):
+    """prepare_shared_layouts hoists the fold layouts out of the
+    per-variant loop: a 2-variant grid over one data source builds
+    prepare_ratings once per fold, and every variant train is a reuse
+    hit."""
+    from unittest import mock
+
+    from predictionio_tpu.models.recommendation import als_algorithm
+    from predictionio_tpu.models.recommendation.evaluation import (
+        RecommendationEvaluation,
+    )
+    from predictionio_tpu.ops import als
+    from predictionio_tpu.workflow import WorkflowContext, run_evaluation
+    from tests.test_evaluation import grid, rated_app  # noqa: F401
+
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "MyApp1", None))
+    memory_storage.get_events().init(app_id)
+    evs = []
+    rng = np.random.default_rng(4)
+    for j in range(160):
+        evs.append(Event(
+            event="rate", entity_type="user", entity_id=f"u{j % 11}",
+            target_entity_type="item", target_entity_id=f"i{j % 9}",
+            properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            event_time=dt.datetime(2021, 1, 1, tzinfo=UTC)
+            + dt.timedelta(minutes=j)))
+    store.write(evs, app_id, storage=memory_storage)
+
+    als_algorithm._BIG_LAYOUT_CACHE.clear()
+    params = grid(ranks=(2, 3), iters=(2,))   # 2 variants, kFold=3
+    builds = []
+    real = als.prepare_ratings
+    with mock.patch.object(
+            als, "prepare_ratings",
+            side_effect=lambda *a, **k: builds.append(1) or real(*a, **k)):
+        hits0 = als_algorithm.LAYOUT_STATS["hits"]
+        run_evaluation(WorkflowContext(storage=memory_storage),
+                       RecommendationEvaluation(), params,
+                       evaluation_class="RecommendationEvaluation")
+        hits = als_algorithm.LAYOUT_STATS["hits"] - hits0
+    assert len(builds) == 3          # one layout per fold, NOT per variant
+    assert hits == 6                 # 2 variants x 3 folds all reused
+
+
+def test_cli_read_flags(monkeypatch):
+    from predictionio_tpu.tools.cli import _apply_read_env, build_parser
+
+    args = build_parser().parse_args(
+        ["train", "--read-threads", "3", "--read-overlap", "off"])
+    assert args.read_threads == 3 and args.read_overlap == "off"
+    monkeypatch.delenv("PIO_READ_THREADS", raising=False)
+    monkeypatch.delenv("PIO_READ_OVERLAP", raising=False)
+    monkeypatch.delenv("PIO_READ_STAGE", raising=False)
+    import os
+    _apply_read_env(args)
+    assert os.environ["PIO_READ_THREADS"] == "3"
+    assert os.environ["PIO_READ_OVERLAP"] == "0"
+    assert os.environ["PIO_READ_STAGE"] == "0"
+    monkeypatch.delenv("PIO_READ_THREADS", raising=False)
+    monkeypatch.delenv("PIO_READ_OVERLAP", raising=False)
+    monkeypatch.delenv("PIO_READ_STAGE", raising=False)
