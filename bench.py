@@ -155,6 +155,112 @@ def measure_read_modes(storage, app_id):
     }
 
 
+def measure_robustness(workdir, n_calls: int = 300,
+                       fault_rate: float = 0.01):
+    """Serving-under-faults leg: p50/p99 and error rate of storage RPCs
+    with 1% injected storage faults (synthetic 503s at the client
+    transport boundary), circuit breaker OFF vs ON, retries configured
+    in both legs (3 attempts, 2 ms full-jitter backoff).
+
+    The signal: bounded retries absorb a 1% fault rate completely
+    (surfaced error rate 0) while the breaker — correctly — stays closed
+    and adds no fast-fail noise at this rate. Under BENCH_STRICT_EXTRAS=1
+    a surfaced error or a spuriously-opened breaker hard-fails the run."""
+    from predictionio_tpu.common import resilience
+    from predictionio_tpu.common.resilience import CircuitBreaker
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.data.storage.remote import serve_storage
+
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": os.path.join(workdir, "robust_el"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    app_id = backing.get_meta_data_apps().insert(App(0, "RobustApp"))
+    ev_b = backing.get_events()
+    ev_b.init(app_id)
+    import datetime as dt
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+    ids = ev_b.insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=f"u{k % 97}",
+               target_entity_type="item", target_entity_id=f"i{k % 53}",
+               properties=DataMap({"rating": float(k % 5) + 1.0}),
+               event_time=t0 + dt.timedelta(seconds=k))
+         for k in range(2000)], app_id)
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+
+    def leg(breaker_on: bool):
+        prior = os.environ.get("PIO_BREAKER_ENABLED")
+        os.environ["PIO_BREAKER_ENABLED"] = "1" if breaker_on else "0"
+        CircuitBreaker.reset_registry()
+        try:
+            remote = Storage(env={
+                "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+                "PIO_STORAGE_SOURCES_R_RETRIES": "3",
+                "PIO_STORAGE_SOURCES_R_BACKOFF_MS": "2",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+            })
+            ev = remote.get_events()
+            inj = resilience.install(
+                f"error:{fault_rate}:503@client", seed=1234)
+            lat, errors = [], 0
+            for k in range(n_calls):
+                t = time.perf_counter()
+                try:
+                    got = ev.get(ids[k % len(ids)], app_id)
+                    assert got is not None
+                except Exception:
+                    errors += 1
+                lat.append((time.perf_counter() - t) * 1e3)
+            resilience.clear()
+            opened = 0
+            if breaker_on:
+                br = CircuitBreaker.for_endpoint(f"127.0.0.1:{port}")
+                opened = br.stats()["opened"] if br else 0
+            return {
+                "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3),
+                "err": errors,
+                "err_rate": round(errors / n_calls, 4),
+                "faults_injected": inj.fired.get("error", 0),
+                "breaker_opened": opened,
+            }
+        finally:
+            resilience.clear()
+            CircuitBreaker.reset_registry()
+            if prior is None:
+                os.environ.pop("PIO_BREAKER_ENABLED", None)
+            else:
+                os.environ["PIO_BREAKER_ENABLED"] = prior
+
+    try:
+        off = leg(False)
+        on = leg(True)
+    finally:
+        server.shutdown()
+        server.server_close()
+        try:
+            ev_b.close()   # flush before the workdir vanishes
+        except Exception:
+            pass
+    return {
+        "robust_fault_rate": fault_rate,
+        "robust_calls_per_leg": n_calls,
+        "robust_breaker_off": off,
+        "robust_breaker_on": on,
+    }
+
+
 def measure_http_ingest(storage, n_users, n_items,
                         n_events: int = 20_000,
                         conn_counts=(1, 8, 32)):
@@ -784,6 +890,16 @@ def main() -> None:
             except Exception as e:
                 ecom = {"ecom_error": f"{type(e).__name__}: {e}"}
 
+        # robustness leg: storage RPCs under 1% injected faults, breaker
+        # off vs on (common/resilience.py); cheap, so it always runs with
+        # the other extras — the hard gates on it are strict-only
+        robust = None
+        if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+            try:
+                robust = measure_robustness(workdir)
+            except Exception as e:
+                robust = {"robust_error": f"{type(e).__name__}: {e}"}
+
         published = {}
         try:
             with open(os.path.join(HERE, "BASELINE.json")) as f:
@@ -846,6 +962,7 @@ def main() -> None:
                 **(throughput or {}),
                 **(eval_grid or {}),
                 **(ecom or {}),
+                **(robust or {}),
                 "device": str(jax.devices()[0]).split(":")[0],
             },
         }))
@@ -869,6 +986,27 @@ def main() -> None:
             failures.append(
                 "parallel and serial bulk reads disagree on checksums "
                 "with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and robust:
+            if robust.get("robust_error"):
+                failures.append(
+                    f"robustness leg crashed ({robust['robust_error']}) "
+                    "with BENCH_STRICT_EXTRAS=1")
+            else:
+                for leg_name in ("robust_breaker_off", "robust_breaker_on"):
+                    leg_r = robust[leg_name]
+                    if leg_r["err"] > 0:
+                        failures.append(
+                            f"{leg_name}: {leg_r['err']} storage errors "
+                            "surfaced despite retries with "
+                            "BENCH_STRICT_EXTRAS=1")
+                    if leg_r["faults_injected"] == 0:
+                        failures.append(
+                            f"{leg_name}: no faults fired — the leg "
+                            "measured nothing")
+                if robust["robust_breaker_on"]["breaker_opened"]:
+                    failures.append(
+                        "breaker opened at a 1% fault rate (threshold "
+                        "misconfigured) with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and (
                 eval_grid or {}).get("eval_error"):
             # by default a crashed eval leg records eval_error and the run

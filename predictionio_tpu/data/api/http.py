@@ -11,10 +11,13 @@ the reference's spray actors over a dispatcher (EventServer.scala:602-663).
 from __future__ import annotations
 
 import json
+import signal
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
+
+from predictionio_tpu.common import resilience
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -31,6 +34,16 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         extra_headers = {}
+        # server-boundary fault injection (PIO_FAULT_SPEC, scope @server):
+        # latency before dispatch, or an aborted connection — the client
+        # sees exactly what a crashed/partitioned daemon produces
+        inj = resilience.active()
+        if inj is not None:
+            try:
+                inj.before_send("server", f"{method} {parsed.path}")
+            except ConnectionError:
+                self.close_connection = True
+                return   # no response bytes at all: a mid-request kill
         try:
             response = self.api.handle(
                 method, parsed.path, query, body, dict(self.headers.items()))
@@ -58,13 +71,34 @@ class _Handler(BaseHTTPRequestHandler):
                     {"message": "response contains non-finite numbers"}
                 ).encode("utf-8")
             ctype = "application/json; charset=UTF-8"
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        for name, value in (extra_headers or {}).items():
-            self.send_header(name, str(value))
-        self.end_headers()
-        self.wfile.write(data)
+        content_length = len(data)
+        if inj is not None:
+            new_status, new_data = inj.on_response(
+                "server", f"{method} {parsed.path}", status, data)
+            if new_status != status:
+                # injected 5xx: a fully-formed synthetic error reply
+                status, data = new_status, new_data
+                content_length = len(data)
+                ctype = "application/json; charset=UTF-8"
+            elif len(new_data) != len(data):
+                # injected truncation: advertise the ORIGINAL length but
+                # send fewer bytes and drop the connection, so the client
+                # observes a genuine torn response (IncompleteRead)
+                data = new_data
+                self.close_connection = True
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(content_length))
+            for name, value in (extra_headers or {}).items():
+                self.send_header(name, str(value))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            # the client gave up on this connection (timeout, retry on a
+            # fresh one, or a mid-request kill); the work is done — losing
+            # the response write is their failure mode, not ours
+            self.close_connection = True
 
     def do_GET(self):  # noqa: N802
         self._dispatch("GET")
@@ -117,11 +151,45 @@ def serve_background(api, host: str = "localhost",
     return server, server.server_address[1]
 
 
-def serve_forever(api, host: str = "localhost", port: int = 7070) -> None:
+def install_sigterm_handler(fn: Callable[[], None]) -> bool:
+    """Route SIGTERM to ``fn`` (run on a fresh thread so the signal
+    frame never blocks). Returns False outside the main thread, where
+    CPython refuses to install handlers — callers then rely on their
+    explicit drain/stop paths instead."""
+    def _handler(_signum, _frame):
+        threading.Thread(target=fn, name="pio-drain", daemon=True).start()
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        return True
+    except ValueError:
+        return False
+
+
+def serve_forever(api, host: str = "localhost", port: int = 7070,
+                  on_drain: Optional[Callable[[], None]] = None) -> None:
+    """Run a daemon until SIGTERM/SIGINT, then shut down GRACEFULLY:
+    mark the api draining (``/readyz`` flips to 503 so load balancers
+    stop routing here), stop accepting connections, and run ``on_drain``
+    exactly once (e.g. flush the eventlog WAL buffers) before returning.
+    In-flight handler threads serialize on their backend locks, so a
+    drain-time flush completes after the writes it races with."""
     server = make_server(api, host, port)
+    drained = threading.Event()
+
+    def _drain():
+        if drained.is_set():
+            return
+        drained.set()
+        setattr(api, "draining", True)
+        server.shutdown()
+
+    install_sigterm_handler(_drain)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
-        server.shutdown()
+        _drain()
+        server.server_close()
+        if on_drain is not None:
+            on_drain()

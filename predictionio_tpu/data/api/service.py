@@ -92,6 +92,10 @@ class EventAPI:
                                 if json_connectors is None else json_connectors)
         self.form_connectors = (default_form_connectors()
                                 if form_connectors is None else form_connectors)
+        #: flipped by the graceful-shutdown path (http.serve_forever on
+        #: SIGTERM) so /readyz steers load balancers away while in-flight
+        #: ingests and the final WAL flush complete
+        self.draining = False
 
     # ------------------------------------------------------------------ auth
     def _authenticate(self, query: Dict[str, str],
@@ -149,6 +153,17 @@ class EventAPI:
         path = path.rstrip("/") or "/"
         if path == "/" and method == "GET":
             return 200, {"status": "alive"}
+        if path == "/healthz" and method == "GET":
+            return 200, {"status": "ok"}
+        if path == "/readyz" and method == "GET":
+            if self.draining:
+                return 503, {"status": "draining"}
+            try:   # storage reachable = the DAOs answer a trivial probe
+                self.access_keys.get("")
+            except Exception as e:
+                return 503, {"status": "unready",
+                             "message": f"{type(e).__name__}: {e}"}
+            return 200, {"status": "ready"}
         if path == "/plugins.json" and method == "GET":
             return 200, self.plugin_context.describe()
         if path.startswith("/plugins/") and method == "GET":

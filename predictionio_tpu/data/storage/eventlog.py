@@ -191,18 +191,46 @@ class _Shard:
 
     # -- append-only file tailing (cross-process read-your-writes) ---------
     def refresh_dict(self) -> None:
+        """Byte-exact dictionary tail: consume only newline-terminated
+        entries, so a torn (partially written) last line — a crash mid-
+        append, or a concurrent writer observed mid-write — is simply
+        left pending instead of raising JSONDecodeError on every refresh.
+        The strings in a torn tail were never referenced by any
+        acknowledged event (insert appends the dictionary BEFORE the
+        WAL), so nothing acknowledged is lost. A COMPLETE line that fails
+        to parse is real corruption of positional state (dropping it
+        would shift every later code) and stays a hard error, now with a
+        diagnosable message."""
         if not os.path.exists(self.dict_path):
             return
         size = os.path.getsize(self.dict_path)
         if size == self.dict_offset:
             return
-        with open(self.dict_path, encoding="utf-8") as f:
-            f.seek(self.dict_offset)
-            for line in f:
-                s = json.loads(line)
-                self.codes[s] = len(self.pool)
-                self.pool.append(s)
-            self.dict_offset = f.tell()
+        start = self.dict_offset
+        with open(self.dict_path, "rb") as f:
+            f.seek(start)
+            data = f.read()
+        end = data.rfind(b"\n")
+        if end < 0:
+            return  # torn/in-progress tail only: retry on a later refresh
+        offset = start
+        for line in data[: end + 1].split(b"\n")[:-1]:
+            try:
+                s = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                raise ValueError(
+                    f"eventlog dictionary corrupted at {self.dict_path} "
+                    f"offset {offset}: {e}") from None
+            self.codes[s] = len(self.pool)
+            self.pool.append(s)
+            offset += len(line) + 1
+        self.dict_offset = start + end + 1
+        if size > self.dict_offset:
+            logger.warning(
+                "eventlog: torn dictionary tail at %s (%d bytes past the "
+                "last complete entry) — the interrupted append was never "
+                "acknowledged; it will be dropped on the next write",
+                self.dict_path, size - self.dict_offset)
 
     def refresh_wal(self) -> None:
         """Sync the buffer view with the writer's per-seq WAL.
@@ -249,22 +277,57 @@ class _Shard:
         if end < 0:
             return
         consumed = data[: end + 1]
+        lines = consumed.split(b"\n")[:-1]
         offset = self.wal_offset
-        for line in consumed.split(b"\n")[:-1]:
+        for k, line in enumerate(lines):
             try:
                 self.buffer.append(Event.from_dict(
                     json.loads(line.decode("utf-8")), validate=False))
-            except (ValueError, UnicodeDecodeError) as e:
-                logger.warning(
-                    "eventlog: skipping corrupt WAL record at %s offset %d "
-                    "(%s) — an acknowledged event may be lost",
-                    path, offset, e)
+            except (ValueError, KeyError, TypeError,
+                    UnicodeDecodeError) as e:
+                if k == len(lines) - 1 and end + 1 == len(data):
+                    # the FINAL record of the file: a torn buffered write
+                    # (multi-line append cut mid-stream can still end in
+                    # \n). The insert was never acknowledged — dropping
+                    # exactly this line is the crash-recovery contract.
+                    logger.warning(
+                        "eventlog: dropping torn WAL tail record at %s "
+                        "offset %d (%s) — the interrupted write was never "
+                        "acknowledged", path, offset, e)
+                else:
+                    logger.warning(
+                        "eventlog: skipping corrupt WAL record at %s "
+                        "offset %d (%s) — an acknowledged event may be "
+                        "lost", path, offset, e)
             offset += len(line) + 1
         self.wal_offset += len(consumed)
 
+    def _repair_torn_tail(self, path: str, consumed: int,
+                          label: str) -> None:
+        """Writer-only crash recovery: drop a torn (unterminated or
+        unparseable) tail left by a previous crash BEFORE appending, so
+        the next record starts on a clean line boundary instead of
+        concatenating with the partial bytes — which would corrupt the
+        first acknowledged write after restart. ``consumed`` is the byte
+        offset of the last complete, parsed record; everything past it
+        was never acknowledged."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size > consumed:
+            logger.warning(
+                "eventlog: truncating torn %s tail at %s (%d unacknowledged "
+                "bytes past the last complete record)",
+                label, path, size - consumed)
+            with open(path, "r+b") as f:
+                f.truncate(consumed)
+
     def append_wal(self, events: Sequence[Event]) -> None:
-        with open(self.wal_path_for(self.next_seq), "a",
-                  encoding="utf-8") as f:
+        path = self.wal_path_for(self.next_seq)
+        if os.path.exists(path):
+            self._repair_torn_tail(path, self.wal_offset, "WAL")
+        with open(path, "a", encoding="utf-8") as f:
             for e in events:
                 f.write(json.dumps(e.to_dict(with_event_id=False)) + "\n")
             f.flush()
@@ -293,6 +356,9 @@ class _Shard:
                 seen.add(s)
         if not new:
             return
+        if os.path.exists(self.dict_path):
+            self._repair_torn_tail(self.dict_path, self.dict_offset,
+                                   "dictionary")
         with open(self.dict_path, "a", encoding="utf-8") as f:
             for s in new:
                 self.codes[s] = len(self.pool)

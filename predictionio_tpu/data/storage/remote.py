@@ -25,10 +25,14 @@ from __future__ import annotations
 import base64
 import datetime as _dt
 import hmac
+import http.client
 import json
+import os
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
+from predictionio_tpu.common import resilience
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
@@ -100,9 +104,20 @@ class StorageRPCAPI:
     """Route handler exposing a Storage over /rpc (host with
     data.api.http.make_server, same pattern as every other daemon)."""
 
+    #: retained replies for deduplicated writes (client retry of a
+    #: committed insert must get the ORIGINAL ids back, not a second copy)
+    DEDUP_KEEP = 4096
+
     def __init__(self, storage, key: Optional[str] = None):
         self.storage = storage
         self.key = key
+        #: health/drain lifecycle: a draining server answers /readyz with
+        #: 503 so load balancers stop routing to it while in-flight RPCs
+        #: (and the final WAL flush) complete.
+        self.draining = False
+        from collections import OrderedDict
+        self._dedup_cache: "OrderedDict[str, Any]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
 
     # -- per-DAO method tables, each entry: args-dict -> JSON-able ----------
     def _events(self, m: str, a: Dict[str, Any]):
@@ -305,11 +320,31 @@ class StorageRPCAPI:
         parts.extend(memoryview(v) for v in arrays.values())
         return b"".join(parts)
 
+    def _readyz(self):
+        """Readiness: not draining AND the backing storage constructs its
+        DAOs (a broken PATH / lost mount turns the probe red before load
+        balancers keep routing into 500s)."""
+        if self.draining:
+            return 503, {"status": "draining"}
+        try:
+            self.storage.get_events()
+            self.storage.get_meta_data_apps()
+        except Exception as e:
+            return 503, {"status": "unready",
+                         "message": f"{type(e).__name__}: {e}"}
+        return 200, {"status": "ready", "proto": 2}
+
     def handle(self, method: str, path: str,
                query: Optional[Dict[str, str]] = None,
                body: bytes = b"",
                headers: Optional[Dict[str, str]] = None):
         headers = {k.lower(): v for k, v in (headers or {}).items()}
+        # health probes are unauthenticated (kubelet/LB style) and leak
+        # nothing beyond liveness/readiness
+        if method == "GET" and path == "/healthz":
+            return 200, {"status": "ok"}
+        if method == "GET" and path == "/readyz":
+            return self._readyz()
         if self.key and not hmac.compare_digest(
                 headers.get("x-pio-storage-key", "").encode(
                     "utf-8", "surrogateescape"),
@@ -318,6 +353,16 @@ class StorageRPCAPI:
         if method == "GET" and path == "/":
             # proto 2 = offset-paged find + binary read_columns/model routes
             return 200, {"status": "alive", "proto": 2}
+        # client-propagated deadline (X-PIO-Deadline-Ms carries the budget
+        # REMAINING at send time): a request whose budget is already spent
+        # fast-fails instead of doing work nobody is waiting for
+        deadline_raw = headers.get("x-pio-deadline-ms")
+        if deadline_raw is not None:
+            try:
+                if float(deadline_raw) <= 0:
+                    return 504, {"message": "deadline exceeded"}
+            except ValueError:
+                pass  # malformed header: serve rather than reject
         try:
             if path == "/rpc/read_columns" and method == "POST":
                 return 200, self._read_columns_raw(body)
@@ -341,8 +386,50 @@ class StorageRPCAPI:
             dao_fn = self._DAOS.get(req.get("dao"))
             if dao_fn is None:
                 return 400, {"message": f"unknown dao {req.get('dao')!r}"}
-            result = dao_fn(self, req.get("method", ""),
-                            req.get("args") or {})
+            # write dedup: a client retrying a possibly-committed write
+            # sends the same one-shot token; replaying the stored reply
+            # instead of the DAO call makes the retry exactly-once. The
+            # token is reserved BEFORE execution so a retry racing the
+            # original request waits for its outcome instead of running
+            # the write a second time.
+            dedup = req.get("dedup")
+            done_event = None
+            if dedup:
+                with self._dedup_lock:
+                    entry = self._dedup_cache.get(dedup)
+                    if entry is None:
+                        done_event = threading.Event()
+                        self._dedup_cache[dedup] = ("inflight", done_event)
+                if entry is not None:
+                    kind, val = entry
+                    if kind == "inflight":
+                        val.wait(30)
+                        with self._dedup_lock:
+                            entry = self._dedup_cache.get(dedup)
+                        kind, val = entry or ("failed", None)
+                    if kind == "done":
+                        return 200, {"result": val, "deduped": True}
+                    # the original attempt failed server-side: executing
+                    # the retry is the correct (normal) retry semantics
+                    with self._dedup_lock:
+                        done_event = threading.Event()
+                        self._dedup_cache[dedup] = ("inflight", done_event)
+            try:
+                result = dao_fn(self, req.get("method", ""),
+                                req.get("args") or {})
+            except BaseException:
+                if dedup:
+                    with self._dedup_lock:
+                        self._dedup_cache.pop(dedup, None)
+                    done_event.set()
+                raise
+            if dedup:
+                with self._dedup_lock:
+                    self._dedup_cache[dedup] = ("done", result)
+                    self._dedup_cache.move_to_end(dedup)
+                    while len(self._dedup_cache) > self.DEDUP_KEEP:
+                        self._dedup_cache.popitem(last=False)
+                done_event.set()
             return 200, {"result": result}
         except (ValueError, KeyError, TypeError) as e:
             return 400, {"message": f"{type(e).__name__}: {e}"}
@@ -362,7 +449,28 @@ class StorageClient:
     when PIO_SSL_CERTFILE is set — serve_storage inherits it via
     common.server_security.maybe_wrap_ssl). CAFILE pins a custom CA (e.g.
     the self-signed cert from conf/); VERIFY=false disables verification
-    for lab setups."""
+    for lab setups.
+
+    Resilience knobs (all default-off; with none set, the wire behavior —
+    headers, payloads, retry pattern — is byte-identical to the
+    pre-resilience driver, i.e. one immediate reconnect retry for
+    idempotent calls and none for writes):
+
+    - RETRIES / PIO_RPC_RETRIES, BACKOFF_MS / PIO_RPC_BACKOFF_MS,
+      BACKOFF_MAX_MS, DEADLINE_MS — the RetryPolicy. Setting ANY of them
+      also enables 5xx (502/503/504) retry with the server's Retry-After
+      honored as the backoff floor, and DEADLINE_MS propagates the
+      remaining budget per attempt via the X-PIO-Deadline-Ms header.
+    - WRITE_DEDUP / PIO_RPC_WRITE_DEDUP=1 — event insert_batch carries a
+      one-shot dedup token the server stores replies under, making the
+      write safely retryable (exactly-once across lost responses).
+    - PIO_BREAKER_ENABLED=1 (+ PIO_BREAKER_*) — a per-endpoint circuit
+      breaker shared by every client in the process; when open, calls
+      fast-fail with CircuitOpenError instead of queueing on a dead
+      endpoint.
+    - PIO_FAULT_SPEC — transport-boundary fault injection (chaos tests
+      and the bench robustness leg; common/resilience.py).
+    """
 
     def __init__(self, config):
         url = config.properties.get("URL", "http://localhost:7072")
@@ -378,6 +486,14 @@ class StorageClient:
         self.verify = (config.properties.get(
             "VERIFY", "true").lower() != "false")
         self._local = threading.local()
+        self.policy = resilience.RetryPolicy.from_env(
+            "PIO_RPC", properties=config.properties)
+        dedup_raw = str(config.properties.get(
+            "WRITE_DEDUP",
+            os.environ.get("PIO_RPC_WRITE_DEDUP", "0"))).lower()
+        self.write_dedup = dedup_raw in ("1", "true", "yes")
+        self.breaker = resilience.CircuitBreaker.for_endpoint(
+            f"{self.host}:{self.port}")
 
     def _conn(self):
         import http.client
@@ -402,33 +518,112 @@ class StorageClient:
     #: methods safe to replay after a dropped keep-alive connection; writes
     #: are NEVER transparently retried (the server may already have applied
     #: them — a replayed insert_batch would double-store every event)
+    #: UNLESS the call carries a dedup token the server replays replies
+    #: under (write_dedup), which makes the retry exactly-once.
     _IDEMPOTENT = frozenset({
         "get", "get_by_name", "get_all", "get_by_appid",
         "get_latest_completed", "get_completed", "find", "init",
     })
 
+    #: transport failures eligible for an idempotent retry; includes
+    #: http.client.HTTPException for torn keep-alive responses
+    #: (IncompleteRead / BadStatusLine after a server restart)
+    _TRANSPORT_ERRORS = (ConnectionError, OSError, http.client.HTTPException)
+
+    def _transact(self, method: str, path: str, body: bytes,
+                  headers: Dict[str, str], idempotent: bool):
+        """One RPC through the full resilience stack: breaker gate, fault
+        injection, bounded idempotency-aware retries with full-jitter
+        backoff, per-attempt deadline header, Retry-After-floored 5xx
+        retry. Returns (status, payload_bytes, response_headers)."""
+        route = f"{method} {path}"
+        deadline = self.policy.deadline_from_now()
+        attempt = 0
+        while True:
+            if self.breaker is not None:
+                self.breaker.allow()   # CircuitOpenError: fast-fail, no retry
+            inj = resilience.active()
+            conn = None
+            try:
+                if inj is not None:
+                    inj.before_send("client", route)
+                hdrs = headers
+                if deadline is not None:
+                    remaining_ms = int((deadline - time.monotonic()) * 1e3)
+                    hdrs = {**headers,
+                            "X-PIO-Deadline-Ms": str(max(0, remaining_ms))}
+                conn = self._conn()
+                conn.request(method, path, body=body, headers=hdrs)
+                if inj is not None:
+                    inj.after_send("client", route)
+                resp = conn.getresponse()
+                chunks = []
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                status, payload = resp.status, b"".join(chunks)
+                rheaders = {k.lower(): v for k, v in resp.getheaders()}
+                if inj is not None:
+                    status, payload = inj.on_response(
+                        "client", route, status, payload)
+            except self._TRANSPORT_ERRORS:
+                # the connection state is unknown; drop it so the retry
+                # (or the next call) reconnects fresh
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                self._local.conn = None
+                if self.breaker is not None:
+                    self.breaker.record(False)
+                if not (idempotent
+                        and self.policy.may_retry(attempt, deadline)):
+                    raise
+                time.sleep(self.policy.backoff_s(attempt))
+                attempt += 1
+                continue
+            if (status in (502, 503, 504) and idempotent
+                    and self.policy.configured
+                    and self.policy.may_retry(attempt, deadline)):
+                if self.breaker is not None:
+                    self.breaker.record(False)
+                try:
+                    floor = float(rheaders.get("retry-after") or 0.0)
+                except ValueError:
+                    floor = 0.0
+                time.sleep(self.policy.backoff_s(attempt, floor=floor))
+                attempt += 1
+                continue
+            if self.breaker is not None:
+                # 4xx is a caller mistake, not endpoint health
+                self.breaker.record(status < 500)
+            return status, payload, rheaders
+
     def call(self, dao: str, method: str, **args) -> Any:
-        payload = json.dumps(
-            {"dao": dao, "method": method, "args": args}).encode()
+        envelope: Dict[str, Any] = {"dao": dao, "method": method,
+                                    "args": args}
+        idempotent = method in self._IDEMPOTENT
+        if (self.write_dedup and dao == "events"
+                and method == "insert_batch"):
+            # one-shot token: the server replays the stored reply if this
+            # exact write already committed, so the retry cannot double-
+            # store events — which is what makes it safe to retry at all
+            import uuid
+            envelope["dedup"] = uuid.uuid4().hex
+            idempotent = True
+        payload = json.dumps(envelope).encode()
         headers = {"Content-Type": "application/json"}
         if self.key:
             headers["X-PIO-Storage-Key"] = self.key
-        retries = (0, 1) if method in self._IDEMPOTENT else (0,)
-        for attempt in retries:
-            conn = self._conn()
-            try:
-                conn.request("POST", "/rpc", body=payload, headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
-                break
-            except (ConnectionError, OSError):
-                self._local.conn = None
-                if attempt == retries[-1]:
-                    raise
+        status, data, _rheaders = self._transact(
+            "POST", "/rpc", payload, headers, idempotent)
         out = json.loads(data.decode("utf-8"))
-        if resp.status != 200:
+        if status != 200:
             raise RuntimeError(
-                f"storage server error {resp.status}: "
+                f"storage server error {status}: "
                 f"{out.get('message', '')}")
         return out.get("result")
 
@@ -437,7 +632,8 @@ class StorageClient:
         find / binary routes report no "proto" field -> 1."""
         if getattr(self, "_proto", None) is None:
             try:
-                status, payload = self.request_raw("GET", "/", retry=True)
+                status, payload = self.request_raw("GET", "/",
+                                                   idempotent=True)
             except Exception:
                 return 1   # transient: do NOT pin; re-probe next call
             if status == 200:
@@ -447,30 +643,25 @@ class StorageClient:
         return self._proto
 
     def request_raw(self, method: str, path: str, body: bytes = b"",
-                    retry: bool = False):
+                    idempotent: Optional[bool] = None):
         """Binary-route transport: returns (status, payload_bytes). The
         response is drained in 1 MiB chunks so a multi-hundred-MB model
-        blob or columnar reply never doubles through a JSON/base64 layer."""
+        blob or columnar reply never doubles through a JSON/base64 layer.
+
+        Retries happen ONLY for idempotent requests (default: GETs). A
+        non-idempotent POST must never be resent blindly — a
+        ConnectionError after the server committed but before the
+        response arrived would otherwise double-apply it. POST callers
+        whose routes ARE replay-safe (columnar reads, same-bytes model
+        puts) opt in explicitly."""
+        if idempotent is None:
+            idempotent = method == "GET"
         headers = {"Content-Type": "application/octet-stream"}
         if self.key:
             headers["X-PIO-Storage-Key"] = self.key
-        retries = (0, 1) if retry else (0,)
-        for attempt in retries:
-            conn = self._conn()
-            try:
-                conn.request(method, path, body=body, headers=headers)
-                resp = conn.getresponse()
-                chunks = []
-                while True:
-                    chunk = resp.read(1 << 20)
-                    if not chunk:
-                        break
-                    chunks.append(chunk)
-                return resp.status, b"".join(chunks)
-            except (ConnectionError, OSError):
-                self._local.conn = None
-                if attempt == retries[-1]:
-                    raise
+        status, payload, _rheaders = self._transact(
+            method, path, body, headers, idempotent)
+        return status, payload
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)
@@ -608,7 +799,7 @@ class RemoteEvents(Events):
             "rating_property": rating_property,
             "read_threads": read_threads}).encode()
         status, payload = self.c.request_raw(
-            "POST", "/rpc/read_columns", body, retry=True)
+            "POST", "/rpc/read_columns", body, idempotent=True)
         if (status == 400 and b"columnar" in payload) or status == 404:
             # backing store has no bulk-read support (or the server predates
             # the route): let the caller (store.find_columnar) fall back to
@@ -621,6 +812,15 @@ class RemoteEvents(Events):
             raise RuntimeError("malformed columnar reply (bad magic)")
         hlen = struct.unpack("<I", payload[4:8])[0]
         header = json.loads(payload[8:8 + hlen].decode("utf-8"))
+        expected = 8 + hlen + sum(
+            n * np.dtype(dtype).itemsize
+            for _name, dtype, n in header["cols"])
+        if len(payload) < expected:
+            # torn mid-body (proxy reset, injected truncation): surface a
+            # clear integrity error rather than frombuffer's size message
+            raise RuntimeError(
+                f"truncated columnar reply ({len(payload)} of "
+                f"{expected} bytes)")
         out = {"pool": header["pool"]}
         mv = memoryview(payload)
         off = 8 + hlen
@@ -786,8 +986,10 @@ class RemoteModels(Models):
                         models=base64.b64encode(m.models).decode())
             return
         import urllib.parse
+        # replay-safe POST: same id + same bytes overwrite in place
         status, payload = self.c.request_raw(
-            "POST", "/rpc/model?id=" + urllib.parse.quote(m.id), m.models)
+            "POST", "/rpc/model?id=" + urllib.parse.quote(m.id), m.models,
+            idempotent=True)
         if status != 200:
             raise RuntimeError(
                 f"storage server error {status}: {payload[:200]!r}")
@@ -801,7 +1003,7 @@ class RemoteModels(Models):
         import urllib.parse
         status, payload = self.c.request_raw(
             "GET", "/rpc/model?id=" + urllib.parse.quote(model_id),
-            retry=True)
+            idempotent=True)
         if status == 404 and b"unknown route" not in payload:
             return None
         if status != 200:

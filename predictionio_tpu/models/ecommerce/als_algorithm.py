@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.common import resilience
 from predictionio_tpu.controller import Algorithm, Params
 from predictionio_tpu.data import store
 from predictionio_tpu.data.bimap import BiMap
@@ -161,6 +162,9 @@ class ECommAlgorithm(Algorithm):
                 target_entity_type="item", storage=self._storage))
         except Exception as e:
             logger.error("Error when read seen events: %s", e)
+            # fail soft: serve from on-device factors without the seen
+            # filter, flagged `degraded: true` by the query server
+            resilience.note_degraded(f"seen-events lookup failed: {e}")
             return set()
 
     def _unavailable_items(self) -> Set[str]:
@@ -172,6 +176,8 @@ class ECommAlgorithm(Algorithm):
                 limit=1, latest=True, storage=self._storage)
         except Exception as e:
             logger.error("Error when read set unavailableItems event: %s", e)
+            resilience.note_degraded(
+                f"unavailableItems lookup failed: {e}")
             return set()
         if not events:
             return set()
@@ -190,6 +196,7 @@ class ECommAlgorithm(Algorithm):
                 limit=1, latest=True, storage=self._storage)
         except Exception as e:
             logger.error("Error when reading set weightedItems event: %s", e)
+            resilience.note_degraded(f"weightedItems lookup failed: {e}")
             return None
         if not events:
             return None
@@ -331,6 +338,7 @@ class ECommAlgorithm(Algorithm):
                 storage=self._storage)
         except Exception as e:
             logger.error("Error when read recent events: %s", e)
+            resilience.note_degraded(f"recent-events lookup failed: {e}")
             return None
         recent_ixs = {model.item_vocab.get(e.target_entity_id)
                       for e in events if e.target_entity_id is not None}

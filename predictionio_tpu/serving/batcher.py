@@ -158,6 +158,11 @@ class MicroBatcher:
                 p.done.set()
 
     # ---------------------------------------------------------------- admin
+    def depth(self) -> int:
+        """Current queue depth (readiness probes)."""
+        with self._cond:
+            return len(self._q)
+
     def close(self, timeout: float = 5.0) -> None:
         """Stop accepting work; the worker drains the queue, then exits."""
         with self._cond:

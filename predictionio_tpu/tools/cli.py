@@ -110,6 +110,9 @@ def _apply_read_env(args) -> None:
 
 def cmd_train(args) -> int:
     _apply_read_env(args)
+    if getattr(args, "no_auto_resume", False):
+        # disable the crashed-run checkpoint scan (workflow/core_workflow)
+        os.environ["PIO_AUTO_RESUME"] = "0"
     if getattr(args, "coordinator", ""):
         if args.num_processes < 1:
             _error("--coordinator requires --num-processes >= 1")
@@ -191,6 +194,7 @@ def cmd_deploy(args) -> int:
         batch_max_size=args.batch_max_size,
         batch_max_delay_ms=args.batch_max_delay_ms,
         batch_max_queue=args.batch_max_queue,
+        drain_grace_s=args.drain_grace_s,
     )
     # undeploy a previous server on the same port (CreateServer.scala:260-294)
     if undeploy(args.ip, args.port):
@@ -255,15 +259,28 @@ def cmd_adminserver(args) -> int:
 def cmd_storageserver(args) -> int:
     """Expose this node's storage over HTTP so other machines can point a
     `remote`-type source at it (the networked-store role the reference
-    fills with PostgreSQL/HBase; data/storage/remote.py)."""
+    fills with PostgreSQL/HBase; data/storage/remote.py). SIGTERM drains
+    gracefully: /readyz flips to 503, the listener stops accepting, and
+    the backing event store flushes its WAL buffers before exit."""
     from predictionio_tpu.data.api.http import serve_forever
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.data.storage.remote import StorageRPCAPI
     key = args.key or os.environ.get("PIO_STORAGE_SERVER_KEY") or None
+    storage = get_storage()
+
+    def flush_events():
+        try:
+            events = storage.get_events()
+            if hasattr(events, "close"):
+                events.close()
+            _info("Storage server drained (event buffers flushed).")
+        except Exception as e:  # pragma: no cover - backend-specific
+            _error(f"Drain-time flush failed: {e}")
+
     _info(f"Storage server is started at {args.ip}:{args.port}"
           f"{' (key auth on)' if key else ''}.")
-    serve_forever(StorageRPCAPI(get_storage(), key=key),
-                  host=args.ip, port=args.port)
+    serve_forever(StorageRPCAPI(storage, key=key),
+                  host=args.ip, port=args.port, on_drain=flush_events)
     return 0
 
 
@@ -470,6 +487,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--resume-from", default=None,
                     help="instance id of a crashed run whose iteration "
                          "snapshots should seed this training")
+    sp.add_argument("--no-auto-resume", action="store_true",
+                    help="do not auto-resume from a prior crashed run's "
+                         "iteration checkpoints (sets PIO_AUTO_RESUME=0)")
     sp.add_argument("--devices", type=int, default=0,
                     help="train block-sharded over the first N devices "
                          "(default: single-device; -1 = all, incl. every "
@@ -518,6 +538,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--batch-max-delay-ms", type=float, default=2.0)
     sp.add_argument("--batch-max-queue", type=int, default=256,
                     help="admission control: 503 beyond this queue depth")
+    sp.add_argument("--drain-grace-s", type=float, default=30.0,
+                    help="SIGTERM graceful drain: seconds to wait for "
+                         "in-flight batches before exiting")
 
     sp = sub.add_parser("undeploy", help="stop a deployed engine server")
     sp.add_argument("--ip", default="localhost")

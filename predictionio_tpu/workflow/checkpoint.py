@@ -77,3 +77,16 @@ def run_checkpoint_dir(instance_id: str) -> str:
     """Conventional checkpoint location for a training run."""
     base = os.path.expanduser(os.environ.get("PIO_FS_BASEDIR", "~/.pio_store"))
     return os.path.join(base, "checkpoints", instance_id)
+
+
+def latest_step_in(directory: str) -> Optional[int]:
+    """Newest snapshot step in ``directory``, or None. Unlike
+    FactorCheckpointer this never creates the directory — it's the
+    side-effect-free probe the auto-resume scan runs over every
+    candidate crashed run."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = [int(m.group(1)) for m in map(_FNAME.match, names) if m]
+    return max(steps) if steps else None

@@ -34,6 +34,35 @@ def _now():
     return _dt.datetime.now(_dt.timezone.utc)
 
 
+def _find_auto_resume(instances, engine_id: str,
+                      engine_variant: str) -> Optional[str]:
+    """Newest crashed run of this engine/variant whose iteration
+    snapshots survived — the auto-resume candidate for `pio train`.
+
+    ERROR rows are runs whose failure was recorded; INIT rows are runs
+    that died before any ledger update (SIGKILL, OOM, power loss). Both
+    keep their FactorCheckpointer directory, which run_train clears only
+    on success. Caveat: an INIT row could belong to a training still
+    running in another process — don't run two trains of the same
+    variant concurrently against one ledger (same contract as the
+    eventlog's single-writer rule); PIO_AUTO_RESUME=0 or
+    `pio train --no-auto-resume` opts out."""
+    from predictionio_tpu.workflow.checkpoint import (
+        latest_step_in, run_checkpoint_dir,
+    )
+    best = None
+    for row in instances.get_all():
+        if (row.engine_id != engine_id
+                or row.engine_variant != engine_variant
+                or row.status not in ("ERROR", "INIT")):
+            continue
+        if latest_step_in(run_checkpoint_dir(row.id)) is None:
+            continue
+        if best is None or row.start_time > best.start_time:
+            best = row
+    return best.id if best else None
+
+
 def run_train(
     ctx: WorkflowContext,
     engine: Engine,
@@ -73,6 +102,17 @@ def run_train(
             return ""
     storage = ctx.storage
     instances = storage.get_meta_data_engine_instances()
+    if (resume_from is None and jax.process_count() == 1
+            and os.environ.get("PIO_AUTO_RESUME", "1") != "0"):
+        # crash recovery: a prior run of this engine/variant that died
+        # (ERROR, or INIT after a hard kill) and left iteration snapshots
+        # seeds this run instead of restarting from iteration 0
+        auto = _find_auto_resume(instances, engine_id, engine_variant)
+        if auto:
+            logger.info(
+                "Auto-resuming from crashed run %s's iteration snapshots "
+                "(disable with --no-auto-resume / PIO_AUTO_RESUME=0)", auto)
+            resume_from = auto
     import json as _json
     pj = params_json or {}
     instance = EngineInstance(

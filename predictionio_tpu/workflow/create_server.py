@@ -28,6 +28,7 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
+from predictionio_tpu.common import resilience
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
 from predictionio_tpu.data.event import (
@@ -74,6 +75,10 @@ class ServerConfig:
     #: admission control: queue depth beyond which /queries.json answers
     #: 503 + Retry-After instead of letting latency grow without bound.
     batch_max_queue: int = 256
+    #: graceful-drain budget (SIGTERM / drain()): how long to wait for
+    #: the batcher worker to finish every admitted in-flight batch
+    #: before the server exits anyway.
+    drain_grace_s: float = 30.0
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -167,11 +172,13 @@ class QueryAPI:
         self._engine_override = engine
         self._lock = threading.Lock()
         self._stop_requested = threading.Event()
+        self._draining = threading.Event()
         self._batcher = None
         # serving stats (CreateServer.scala:399-401)
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        self.degraded_count = 0
         self.start_time = utcnow()
         self._load()
 
@@ -230,11 +237,19 @@ class QueryAPI:
             return None
 
         def flush(queries):
+            # degraded tracking rides the worker thread for the whole
+            # batch: a failed side-channel lookup during any query of the
+            # flush taints every result of that flush (conservative — the
+            # lookups run inside predict_batch where per-query attribution
+            # is not visible from here; KNOWN_ISSUES documents this)
+            resilience.reset_degraded()
             supplemented = [serving.supplement(q) for q in queries]
             per_algo = [protocol.predict_batch(a, m, supplemented)
                         for a, m in zip(algorithms, models)]
-            return [serving.serve(q, [col[j] for col in per_algo])
-                    for j, q in enumerate(queries)]
+            served = [serving.serve(q, [col[j] for col in per_algo])
+                      for j, q in enumerate(queries)]
+            degraded = bool(resilience.pop_degraded())
+            return [(p, degraded) for p in served]
 
         return MicroBatcher(
             flush,
@@ -245,6 +260,36 @@ class QueryAPI:
     @property
     def stop_requested(self) -> bool:
         return self._stop_requested.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @draining.setter
+    def draining(self, value: bool) -> None:
+        """Generic lifecycle hook (http.serve_forever flips this on
+        SIGTERM for daemons without a richer drain path); setting it
+        True runs the full drain."""
+        if value:
+            self.drain()
+
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Graceful shutdown: stop admitting queries (/readyz -> 503,
+        /queries.json -> 503 + Retry-After), let the batcher worker
+        finish EVERY already-admitted batch, then request stop. Safe to
+        call more than once; every admitted in-flight request gets its
+        real answer — zero are dropped."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        logger.info("drain: stopped admitting; flushing batcher")
+        with self._lock:
+            batcher = self._batcher
+        if batcher is not None:
+            batcher.close(timeout=grace_s if grace_s is not None
+                          else self.config.drain_grace_s)
+        self._stop_requested.set()
+        logger.info("drain: complete")
 
     def close(self) -> None:
         """Drain and retire the request batcher (server shutdown). Queries
@@ -264,6 +309,11 @@ class QueryAPI:
         try:
             if path == "/" and method == "GET":
                 return 200, self._status()
+            if path == "/healthz" and method == "GET":
+                # liveness: the process is up and dispatching
+                return 200, {"status": "ok"}
+            if path == "/readyz" and method == "GET":
+                return self._readyz()
             if path == "/queries.json" and method == "POST":
                 return self._queries(body)
             if path == "/reload" and method == "POST":
@@ -296,12 +346,45 @@ class QueryAPI:
             "requestCount": self.request_count,
             "avgServingSec": self.avg_serving_sec,
             "lastServingSec": self.last_serving_sec,
+            "degradedCount": self.degraded_count,
+            "draining": self._draining.is_set(),
             "serverStartTime": format_event_time(self.start_time),
         }
         batcher = self._batcher
         out["batching"] = ({"enabled": True, **batcher.stats()}
                            if batcher is not None else {"enabled": False})
         return out
+
+    def _readyz(self) -> Response:
+        """Readiness: a model is deployed, the admission queue has room,
+        and the engine's storage answers a trivial probe. 503 while
+        draining so load balancers stop routing here before shutdown."""
+        if self._draining.is_set():
+            return 503, {"status": "draining"}
+        checks: Dict[str, Any] = {}
+        ready = True
+        with self._lock:
+            instance = getattr(self, "engine_instance", None)
+            batcher = self._batcher
+        checks["modelLoaded"] = instance is not None
+        ready &= checks["modelLoaded"]
+        if batcher is not None:
+            depth = batcher.depth()
+            checks["queueDepth"] = depth
+            # saturated queue = not ready for MORE traffic (the depth at
+            # which submit() starts answering 503 anyway)
+            ready &= depth < self.config.batch_max_queue
+        try:
+            # one cheap metadata point-read; for a `remote` source this is
+            # a real RPC, i.e. the probe genuinely exercises the link
+            if instance is not None:
+                self.storage.get_meta_data_engine_instances().get(instance.id)
+            checks["storage"] = "ok"
+        except Exception as e:
+            checks["storage"] = f"{type(e).__name__}: {e}"
+            ready = False
+        status = 200 if ready else 503
+        return status, {"status": "ready" if ready else "unready", **checks}
 
     def _reload(self) -> None:
         try:
@@ -314,6 +397,11 @@ class QueryAPI:
         from predictionio_tpu.serving import ServerSaturated
         t0 = time.perf_counter()
         query_time = utcnow()
+        if self._draining.is_set():
+            # graceful drain: already-admitted requests finish; new ones
+            # are steered to another replica
+            return 503, {"message": "server is draining"}, \
+                {"Retry-After": "1"}
         with self._lock:
             algorithms, models, serving, batcher = (
                 self.algorithms, self.models, self.serving, self._batcher)
@@ -327,18 +415,33 @@ class QueryAPI:
             # micro-batched path: block until this query's coalesced batch
             # is served; concurrent requests share one device dispatch
             try:
-                prediction = batcher.submit(query)
+                prediction, degraded = batcher.submit(query)
             except ServerSaturated as e:
                 return 503, {"message": (
                     "serving queue is saturated (admission control); "
                     "retry later")}, {"Retry-After": str(e.retry_after_s)}
+            except RuntimeError:
+                # lost the race with drain()/close(): the batcher stopped
+                # admitting between our snapshot and submit
+                return 503, {"message": "server is draining"}, \
+                    {"Retry-After": "1"}
         else:
-            # batching off: the original single-query path, unchanged
+            # batching off: the original single-query path, unchanged —
+            # plus request-scoped degradation tracking (a failed storage
+            # side-channel lookup serves from on-device factors and flags
+            # the response instead of 500ing)
+            resilience.reset_degraded()
             supplemented = serving.supplement(query)
             predictions = [a.predict(m, supplemented)
                            for a, m in zip(algorithms, models)]
             prediction = serving.serve(query, predictions)
+            degraded = bool(resilience.pop_degraded())
         result = json_extractor.to_json_obj(prediction)
+        if degraded:
+            with self._lock:
+                self.degraded_count += 1
+            if isinstance(result, dict):
+                result = {**result, "degraded": True}
 
         if self.config.feedback:
             result = self._feedback(instance, query, prediction, result,
@@ -437,8 +540,14 @@ def undeploy(ip: str, port: int) -> bool:
 
 def serve(api: QueryAPI, host: str = "localhost", port: int = 8000,
           bind_retries: int = 3) -> None:
-    """Run until /stop (MasterActor bind + retry, CreateServer.scala:347-357)."""
-    from predictionio_tpu.data.api.http import make_server
+    """Run until /stop or SIGTERM (MasterActor bind + retry,
+    CreateServer.scala:347-357). SIGTERM triggers the graceful drain:
+    /readyz flips to 503, new queries get 503 + Retry-After, the batcher
+    finishes every admitted in-flight batch, then the server exits —
+    the rolling-restart contract (zero dropped in-flight requests)."""
+    from predictionio_tpu.data.api.http import (
+        install_sigterm_handler, make_server,
+    )
     server = None
     for attempt in range(bind_retries):
         try:
@@ -449,6 +558,7 @@ def serve(api: QueryAPI, host: str = "localhost", port: int = 8000,
                 raise
             logger.warning("Bind failed; retrying in 1s...")
             time.sleep(1)
+    install_sigterm_handler(api.drain)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     logger.info("Engine server online at http://%s:%s", host, port)
     try:

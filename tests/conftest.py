@@ -34,6 +34,11 @@ def pytest_configure(config):
         "markers",
         "slow: long-running load/throughput tests excluded from tier-1 "
         "(run with `-m slow`)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / crash-recovery suite (tests marked ONLY "
+        "chaos are the fast smoke subset and run in tier-1; the heavy "
+        "legs carry chaos+slow and run with `-m chaos`)")
 
 
 @pytest.fixture()

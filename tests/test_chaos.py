@@ -1,0 +1,629 @@
+"""Chaos suite: end-to-end fault injection across the distributed edges.
+
+Every test here exercises a REAL failure path — lost responses, dead
+endpoints, retry storms, drain-during-burst — through the actual HTTP
+transport and storage stack, driven by common/resilience.FaultInjector.
+
+Markers: the whole module is `chaos`. Tests carrying ONLY that marker
+are the fast smoke subset and run in tier-1 (`-m "not slow"`); the
+heavier soak legs also carry `slow` and run via `-m chaos`.
+"""
+
+import datetime as dt
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.common import resilience
+from predictionio_tpu.common.resilience import CircuitBreaker, CircuitOpenError
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.data.storage.remote import StorageRPCAPI, serve_storage
+
+pytestmark = pytest.mark.chaos
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(autouse=True)
+def _clean_injection():
+    """No fault spec or breaker state leaks between tests."""
+    resilience.clear()
+    CircuitBreaker.reset_registry()
+    yield
+    resilience.clear()
+    CircuitBreaker.reset_registry()
+
+
+def _mk(eid="u1", iid="i1", rating=3.0, sec=0):
+    return Event(event="rate", entity_type="user", entity_id=eid,
+                 target_entity_type="item", target_entity_id=iid,
+                 properties=DataMap({"rating": rating}),
+                 event_time=dt.datetime(2021, 1, 1, tzinfo=UTC)
+                 + dt.timedelta(seconds=sec))
+
+
+def _backing(tmp_path, kind="eventlog"):
+    if kind == "memory":
+        env = {
+            "PIO_STORAGE_SOURCES_B_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "B",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "B",
+        }
+    else:
+        env = {
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        }
+    return Storage(env=env)
+
+
+def _remote(port, **props):
+    env = {
+        "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+    }
+    for k, v in props.items():
+        env[f"PIO_STORAGE_SOURCES_R_{k}"] = str(v)
+    return Storage(env=env)
+
+
+# ---------------------------------------------------------------------------
+# storage server death + retry recovery
+# ---------------------------------------------------------------------------
+
+def test_server_killed_between_reads_recovers_by_reconnect(tmp_path):
+    """Kill the storage server, restart it on the same port: the client's
+    dead keep-alive connection turns into a ConnectionError that the
+    idempotent read path retries on a fresh connection — identical rows,
+    no duplicates, no missing."""
+    backing = _backing(tmp_path)
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    ev_b = backing.get_events()
+    ev_b.init(app_id)
+    ev_b.insert_batch([_mk(f"u{k}", f"i{k % 3}", sec=k) for k in range(20)],
+                      app_id)
+
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    remote = _remote(port)
+    ev = remote.get_events()
+    before = ev.read_columns(app_id, event_names=["rate"])
+    assert len(before["rating"]) == 20
+
+    server.shutdown()
+    server.server_close()           # the "kill"
+    server2 = serve_storage(backing, host="127.0.0.1", port=port)
+    try:
+        after = ev.read_columns(app_id, event_names=["rate"])
+        np.testing.assert_array_equal(before["entity_code"],
+                                      after["entity_code"])
+        np.testing.assert_array_equal(before["rating"], after["rating"])
+        assert len(list(ev.find(app_id))) == 20
+    finally:
+        server2.shutdown()
+        server2.server_close()
+
+
+def test_response_loss_mid_read_columns_retried(tmp_path):
+    """The acceptance scenario: the server dies mid-read_columns (request
+    processed, response lost). With retries configured, the idempotent
+    binary route replays and returns the full, identical rows."""
+    backing = _backing(tmp_path)
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    ev_b = backing.get_events()
+    ev_b.init(app_id)
+    ev_b.insert_batch([_mk(f"u{k}", f"i{k % 3}", sec=k) for k in range(10)],
+                      app_id)
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    try:
+        remote = _remote(server.server_address[1], RETRIES=2,
+                         BACKOFF_MS=1)
+        inj = resilience.install("drop_rx:1:1@read_columns")
+        cols = remote.get_events().read_columns(app_id,
+                                                event_names=["rate"])
+        assert inj.fired.get("drop_rx") == 1   # the fault really fired
+        assert len(cols["rating"]) == 10
+        direct = ev_b.read_columns(app_id, event_names=["rate"])
+        np.testing.assert_array_equal(cols["entity_code"],
+                                      direct["entity_code"])
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the unsafe-retry bug and its dedup fix
+# ---------------------------------------------------------------------------
+
+def test_write_response_loss_surfaces_error_without_dedup(tmp_path):
+    """Satellite #1, the latent bug made explicit: a ConnectionError
+    AFTER the server committed an insert must NOT be silently retried —
+    a blind resend would double-store every event. Without dedup the
+    client surfaces the error; the server holds exactly one copy."""
+    backing = _backing(tmp_path, "memory")
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    backing.get_events().init(app_id)
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    try:
+        remote = _remote(server.server_address[1], RETRIES=3,
+                         BACKOFF_MS=1)
+        resilience.install("drop_rx:1:1@client POST /rpc")
+        with pytest.raises((ConnectionError, OSError)):
+            remote.get_events().insert(_mk(), app_id)
+        # the request DID reach the server (it processes the already-sent
+        # bytes on its own thread); poll for the commit, then confirm the
+        # client never resent it
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if list(backing.get_events().find(app_id)):
+                break
+            time.sleep(0.01)
+        assert len(list(backing.get_events().find(app_id))) == 1
+        time.sleep(0.05)   # any (buggy) resend would land by now
+        assert len(list(backing.get_events().find(app_id))) == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_write_dedup_makes_insert_retry_exactly_once(tmp_path):
+    """With WRITE_DEDUP on, the retried insert carries the same one-shot
+    token; the server replays the stored reply instead of re-inserting:
+    the client gets the ORIGINAL event ids and the store holds exactly
+    one copy — exactly-once across a lost response."""
+    backing = _backing(tmp_path, "memory")
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    backing.get_events().init(app_id)
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    try:
+        remote = _remote(server.server_address[1], RETRIES=3,
+                         BACKOFF_MS=1, WRITE_DEDUP=1)
+        inj = resilience.install("drop_rx:1:1@client POST /rpc")
+        ids = remote.get_events().insert_batch(
+            [_mk("u1", "i1"), _mk("u2", "i2", sec=1)], app_id)
+        assert inj.fired.get("drop_rx") == 1
+        stored = list(backing.get_events().find(app_id))
+        assert len(stored) == 2                      # no duplicates
+        assert sorted(ids) == sorted(e.event_id for e in stored)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker end-to-end
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_fast_fails_and_recovers_endtoend(
+        tmp_path, monkeypatch):
+    """Sustained faults open the shared per-endpoint breaker: calls fast-
+    fail without touching the wire; after open_s a half-open probe goes
+    through and, once the endpoint heals, closes the breaker."""
+    monkeypatch.setenv("PIO_BREAKER_ENABLED", "1")
+    monkeypatch.setenv("PIO_BREAKER_MIN_CALLS", "4")
+    monkeypatch.setenv("PIO_BREAKER_ERROR_RATE", "0.5")
+    monkeypatch.setenv("PIO_BREAKER_OPEN_S", "0.3")
+    CircuitBreaker.reset_registry()
+
+    backing = _backing(tmp_path, "memory")
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    backing.get_events().init(app_id)
+
+    calls = {"n": 0}
+    real_handle = StorageRPCAPI.handle
+
+    class Counting:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def handle(self, *a, **kw):
+            calls["n"] += 1
+            return real_handle(self.inner, *a, **kw)
+
+    from predictionio_tpu.data.api.http import serve_background
+    api = Counting(StorageRPCAPI(backing))
+    server, port = serve_background(api, host="127.0.0.1")
+    try:
+        remote = _remote(port)
+        ev = remote.get_events()
+        resilience.install("error:1:503@client")
+        for _ in range(4):    # sustained faults fill the window
+            with pytest.raises(RuntimeError, match="503"):
+                ev.get("nope", app_id)
+        wire_before = calls["n"]
+        with pytest.raises(CircuitOpenError):   # OPEN: fast-fail
+            ev.get("nope", app_id)
+        assert calls["n"] == wire_before        # nothing touched the wire
+        # endpoint heals; after open_s the half-open probe closes it
+        resilience.clear()
+        time.sleep(0.35)
+        assert ev.get("nope", app_id) is None   # probe succeeds
+        assert ev.get("nope", app_id) is None   # breaker closed again
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# health probes, deadline, defaults wire parity
+# ---------------------------------------------------------------------------
+
+def test_storage_server_health_probes_and_drain(tmp_path):
+    api = StorageRPCAPI(_backing(tmp_path, "memory"), key="sekrit")
+    # health endpoints answer WITHOUT the storage key (LB probes)
+    assert api.handle("GET", "/healthz")[0] == 200
+    status, payload = api.handle("GET", "/readyz")
+    assert status == 200 and payload["status"] == "ready"
+    api.draining = True
+    status, payload = api.handle("GET", "/readyz")
+    assert status == 503 and payload["status"] == "draining"
+    # a spent deadline fast-fails before the DAO dispatch
+    status, _ = api.handle(
+        "POST", "/rpc",
+        body=json.dumps({"dao": "apps", "method": "get_all",
+                         "args": {}}).encode(),
+        headers={"X-PIO-Storage-Key": "sekrit",
+                 "X-PIO-Deadline-Ms": "0"})
+    assert status == 504
+
+
+def test_event_server_health_probes(memory_storage):
+    from predictionio_tpu.data.api import EventAPI
+    api = EventAPI(storage=memory_storage)
+    assert api.handle("GET", "/healthz")[0] == 200
+    assert api.handle("GET", "/readyz")[0] == 200
+    api.draining = True
+    status, payload = api.handle("GET", "/readyz")
+    assert status == 503 and payload["status"] == "draining"
+
+
+def test_defaults_wire_byte_identical(tmp_path):
+    """Acceptance: with PIO_FAULT_SPEC unset and every resilience knob at
+    its default, the remote wire traffic is byte-identical to the
+    pre-PR driver — no deadline header, no dedup field, same legacy
+    retry shape (one reconnect retry for idempotent calls only)."""
+    backing = _backing(tmp_path, "memory")
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    backing.get_events().init(app_id)
+
+    seen = []
+    real_handle = StorageRPCAPI.handle
+
+    class Recording:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def handle(self, method, path, query=None, body=b"",
+                   headers=None):
+            seen.append((method, path, dict(headers or {}), bytes(body)))
+            return real_handle(self.inner, method, path, query, body,
+                               headers)
+
+    from predictionio_tpu.data.api.http import serve_background
+    server, port = serve_background(Recording(StorageRPCAPI(backing)),
+                                    host="127.0.0.1")
+    try:
+        remote = _remote(port)
+        ev = remote.get_events()
+        ev.insert(_mk(), app_id)
+        assert len(list(ev.find(app_id))) == 1
+        for _method, _path, headers, body in seen:
+            assert not any(h.lower() == "x-pio-deadline-ms"
+                           for h in headers)
+            if body[:1] == b"{":
+                envelope = json.loads(body)
+                if "dao" in envelope:
+                    assert set(envelope) == {"dao", "method", "args"}
+        # legacy retry shape: a pre-send drop is retried for a read...
+        n_before = len(seen)
+        resilience.install("drop:1:1@client")
+        assert len(list(ev.find(app_id))) == 1
+        resilience.clear()
+        # ...but an insert facing a pre-send drop fails WITHOUT a resend
+        resilience.install("drop:1:1@client")
+        with pytest.raises((ConnectionError, OSError)):
+            ev.insert(_mk("u2"), app_id)
+        resilience.clear()
+        inserts = [s for s in seen[n_before:]
+                   if b"insert_batch" in s[3]]
+        assert inserts == []   # the dropped insert never hit the wire
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# query server: drain under a concurrent burst + degraded responses
+# ---------------------------------------------------------------------------
+
+def _train_tiny(memory_storage):
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.data import store
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from predictionio_tpu.workflow import WorkflowContext, run_train
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "ChaosApp", None))
+    memory_storage.get_events().init(app_id)
+    events = []
+    for u in range(8):
+        for i in range(6):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": 5.0 if (u % 2) == (i % 2) else 1.0}),
+                event_time=dt.datetime(2021, 1, 1, 0, (u * 6 + i) % 60,
+                                       tzinfo=UTC)))
+    store.write(events, app_id, storage=memory_storage)
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="ChaosApp"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=4, numIterations=3,
+                                       lambda_=0.05, seed=3)),))
+    run_train(
+        WorkflowContext(storage=memory_storage), engine, ep,
+        engine_factory=("predictionio_tpu.models.recommendation"
+                        ":RecommendationEngine"),
+        params_json={
+            "datasource": {"params": {"appName": "ChaosApp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "numIterations": 3, "lambda": 0.05,
+                "seed": 3}}]})
+
+
+def test_drain_during_burst_drops_zero_inflight(memory_storage):
+    """Acceptance: SIGTERM (-> drain()) during a concurrent query burst.
+    Every admitted request completes with its real answer; late arrivals
+    get a clean 503 + Retry-After; zero requests hang or error out."""
+    from predictionio_tpu.workflow.create_server import (
+        QueryAPI, ServerConfig,
+    )
+    _train_tiny(memory_storage)
+    api = QueryAPI(storage=memory_storage, config=ServerConfig(
+        batching="on", batch_max_size=4, batch_max_delay_ms=20.0))
+    body = json.dumps({"user": "u1", "num": 3}).encode()
+    results = [None] * 24
+    started = threading.Barrier(25, timeout=10)
+
+    def client(k):
+        started.wait()
+        time.sleep(0.002 * k)   # stagger across the drain point
+        results[k] = api.handle("POST", "/queries.json", body=body)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(24)]
+    for t in threads:
+        t.start()
+    started.wait()
+    time.sleep(0.01)
+    api.drain()
+    for t in threads:
+        t.join(15)
+        assert not t.is_alive(), "a request hung through drain"
+
+    statuses = [r[0] for r in results]
+    assert set(statuses) <= {200, 503}, statuses
+    assert statuses.count(200) >= 1     # the early ones were served
+    for status, *rest in results:
+        if status == 200:
+            assert rest[0]["itemScores"], "admitted request lost its answer"
+    # post-drain surface: not ready, queries 503, stop requested
+    assert api.handle("GET", "/readyz")[0] == 503
+    assert api.handle("POST", "/queries.json", body=body)[0] == 503
+    assert api.stop_requested
+    # idempotent: a second drain is a no-op
+    api.drain()
+    api.close()
+
+
+def test_sigterm_handler_invokes_drain():
+    """The actual signal wiring: SIGTERM delivered to the process runs
+    the registered drain callback (on its own thread)."""
+    import os
+    import signal
+
+    from predictionio_tpu.data.api.http import install_sigterm_handler
+    prior = signal.getsignal(signal.SIGTERM)
+    drained = threading.Event()
+    try:
+        assert install_sigterm_handler(drained.set) is True
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert drained.wait(5), "SIGTERM did not reach the drain callback"
+    finally:
+        signal.signal(signal.SIGTERM, prior)
+
+
+def test_query_api_healthz_readyz(memory_storage):
+    from predictionio_tpu.workflow.create_server import QueryAPI
+    _train_tiny(memory_storage)
+    api = QueryAPI(storage=memory_storage)
+    assert api.handle("GET", "/healthz")[0] == 200
+    status, payload = api.handle("GET", "/readyz")
+    assert status == 200
+    assert payload["modelLoaded"] is True and payload["storage"] == "ok"
+    api.close()
+
+
+def test_degraded_side_channel_flags_response(memory_storage):
+    """A failed storage side-channel lookup mid-request serves from
+    on-device factors with `degraded: true` instead of a 500 — on both
+    the batched and the inline path."""
+    from predictionio_tpu.workflow.create_server import (
+        QueryAPI, ServerConfig,
+    )
+    _train_tiny(memory_storage)
+    body = json.dumps({"user": "u1", "num": 3}).encode()
+
+    for batching in ("on", "off"):
+        api = QueryAPI(storage=memory_storage,
+                       config=ServerConfig(batching=batching))
+        algo = api.algorithms[0]
+        if batching == "on":
+            real = type(algo).predict_batch
+
+            def flaky_batch(model, queries, _real=real, _a=algo):
+                resilience.note_degraded("chaos: lookup failed")
+                return _real(_a, model, queries)
+
+            algo.predict_batch = flaky_batch
+        else:
+            real_p = type(algo).predict
+
+            def flaky(model, query, _real=real_p, _a=algo):
+                resilience.note_degraded("chaos: lookup failed")
+                return _real(_a, model, query)
+
+            algo.predict = flaky
+        status, payload = api.handle("POST", "/queries.json", body=body)
+        assert status == 200, payload
+        assert payload.get("degraded") is True
+        assert payload["itemScores"]
+        assert api.degraded_count >= 1
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: pio train auto-resume
+# ---------------------------------------------------------------------------
+
+def _train_ckpt(memory_storage, iters=3):
+    """Tiny recommendation train WITH iteration checkpointing; returns
+    (ctx, instance_id)."""
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.data import store
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from predictionio_tpu.workflow import WorkflowContext, run_train
+    apps = memory_storage.get_meta_data_apps()
+    if not apps.get_by_name("ChaosApp"):
+        apps.insert(App(0, "ChaosApp", None))
+    app_id = apps.get_by_name("ChaosApp").id
+    memory_storage.get_events().init(app_id)
+    events = [Event(
+        event="rate", entity_type="user", entity_id=f"u{u}",
+        target_entity_type="item", target_entity_id=f"i{i}",
+        properties=DataMap({"rating": 5.0 if (u % 2) == (i % 2) else 1.0}),
+        event_time=dt.datetime(2021, 1, 1, 0, (u * 6 + i) % 60, tzinfo=UTC))
+        for u in range(8) for i in range(6)]
+    store.write(events, app_id, storage=memory_storage)
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="ChaosApp"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=4, numIterations=iters,
+                                       lambda_=0.05, seed=3,
+                                       checkpointInterval=1)),))
+    ctx = WorkflowContext(storage=memory_storage)
+    iid = run_train(
+        ctx, engine, ep,
+        engine_factory=("predictionio_tpu.models.recommendation"
+                        ":RecommendationEngine"))
+    return ctx, iid
+
+
+def test_train_auto_resumes_from_crashed_run(memory_storage, tmp_path,
+                                             monkeypatch):
+    """A prior run of the same engine/variant that crashed (ERROR row,
+    surviving FactorCheckpointer dir) seeds the next `pio train`
+    automatically; on success the snapshots are cleared."""
+    from predictionio_tpu.data.storage import EngineInstance
+    from predictionio_tpu.workflow.checkpoint import (
+        FactorCheckpointer, latest_step_in, run_checkpoint_dir,
+    )
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    now = dt.datetime.now(UTC)
+    crashed_id = memory_storage.get_meta_data_engine_instances().insert(
+        EngineInstance(
+            id="", status="ERROR", start_time=now, end_time=now,
+            engine_id="default", engine_version="NOT_USED",
+            engine_variant="default", engine_factory="f"))
+    rng = np.random.default_rng(0)
+    FactorCheckpointer(run_checkpoint_dir(crashed_id)).save(1, {
+        "U": rng.normal(size=(8, 4)), "V": rng.normal(size=(6, 4))})
+
+    ctx, iid = _train_ckpt(memory_storage)
+    # the run adopted the crashed run's checkpoint directory...
+    assert ctx.checkpoint_dir == run_checkpoint_dir(crashed_id)
+    row = memory_storage.get_meta_data_engine_instances().get(iid)
+    assert row.status == "COMPLETED"
+    # ...and cleared the scratch snapshots on success
+    assert latest_step_in(run_checkpoint_dir(crashed_id)) is None
+
+
+def test_train_auto_resume_opt_out(memory_storage, tmp_path, monkeypatch):
+    from predictionio_tpu.data.storage import EngineInstance
+    from predictionio_tpu.workflow.checkpoint import (
+        FactorCheckpointer, run_checkpoint_dir,
+    )
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    monkeypatch.setenv("PIO_AUTO_RESUME", "0")
+    now = dt.datetime.now(UTC)
+    crashed_id = memory_storage.get_meta_data_engine_instances().insert(
+        EngineInstance(
+            id="", status="ERROR", start_time=now, end_time=now,
+            engine_id="default", engine_version="NOT_USED",
+            engine_variant="default", engine_factory="f"))
+    rng = np.random.default_rng(0)
+    FactorCheckpointer(run_checkpoint_dir(crashed_id)).save(1, {
+        "U": rng.normal(size=(8, 4)), "V": rng.normal(size=(6, 4))})
+    ctx, iid = _train_ckpt(memory_storage)
+    assert ctx.checkpoint_dir == run_checkpoint_dir(iid)   # its own dir
+
+
+# ---------------------------------------------------------------------------
+# soak: mixed faults under retries (heavy — excluded from tier-1)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_mixed_faults_zero_surfaced_errors(tmp_path):
+    """200 reads under 5% drops + 5% 503s + 20% added latency: with
+    retries configured every single call succeeds, and the data is
+    identical to a clean read."""
+    backing = _backing(tmp_path)
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    ev_b = backing.get_events()
+    ev_b.init(app_id)
+    ev_b.insert_batch([_mk(f"u{k}", f"i{k % 5}", sec=k) for k in range(50)],
+                      app_id)
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    try:
+        remote = _remote(server.server_address[1], RETRIES=4,
+                         BACKOFF_MS=2, BACKOFF_MAX_MS=20)
+        ev = remote.get_events()
+        clean = ev.read_columns(app_id, event_names=["rate"])
+        inj = resilience.install(
+            "drop:0.05@client,error:0.05:503@client,latency:0.2:2@client",
+            seed=7)
+        errors = 0
+        for k in range(200):
+            try:
+                if k % 10 == 0:
+                    cols = ev.read_columns(app_id, event_names=["rate"])
+                    np.testing.assert_array_equal(cols["rating"],
+                                                  clean["rating"])
+                else:
+                    ev.get(f"missing-{k}", app_id)
+            except Exception:
+                errors += 1
+        assert errors == 0
+        assert inj.fired   # the storm actually happened
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -1,0 +1,260 @@
+"""Unit tests for common/resilience.py: retry policy schedule, circuit
+breaker state machine (with an injected clock), fault-injector spec
+parsing and determinism, and the request-scoped degradation flag."""
+
+import threading
+
+import pytest
+
+from predictionio_tpu.common import resilience
+from predictionio_tpu.common.resilience import (
+    CircuitBreaker, CircuitOpenError, FaultInjector, FaultSpecError,
+    InjectedFault, RetryPolicy,
+)
+
+
+# ------------------------------------------------------------- RetryPolicy
+def test_default_policy_is_legacy_single_reconnect():
+    """Zero-config must reproduce the historical transport behavior: one
+    extra attempt, no sleep, no deadline, and `configured` False so the
+    opt-in behaviors (5xx retry, deadline header) stay off."""
+    p = RetryPolicy.from_env(prefix="PIO_TEST_UNSET")
+    assert p.max_attempts == 2
+    assert p.base_delay_s == 0.0
+    assert p.total_deadline_s is None
+    assert p.configured is False
+    assert p.backoff_s(0) == 0.0 and p.backoff_s(5) == 0.0
+
+
+def test_from_env_and_properties(monkeypatch):
+    monkeypatch.setenv("PIO_T1_RETRIES", "3")
+    monkeypatch.setenv("PIO_T1_BACKOFF_MS", "10")
+    p = RetryPolicy.from_env(prefix="PIO_T1")
+    assert p.max_attempts == 4 and p.base_delay_s == 0.01
+    assert p.configured is True
+    # config properties win over env
+    p2 = RetryPolicy.from_env(prefix="PIO_T1",
+                              properties={"RETRIES": "0"})
+    assert p2.max_attempts == 1 and p2.configured is True
+
+
+def test_backoff_full_jitter_bounded_and_floor():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.3)
+    for attempt, cap in ((0, 0.1), (1, 0.2), (2, 0.3), (5, 0.3)):
+        for _ in range(20):
+            assert 0.0 <= p.backoff_s(attempt) <= cap
+    # a server Retry-After hint floors the pause
+    assert p.backoff_s(0, floor=2.5) == 2.5
+
+
+def test_call_retries_then_succeeds_and_exhausts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=3)
+    assert p.call(flaky, sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+    calls.clear()
+    with pytest.raises(ConnectionError):
+        RetryPolicy(max_attempts=2).call(flaky, sleep=lambda s: None)
+    assert len(calls) == 2  # bounded: first try + one retry
+
+
+def test_total_deadline_stops_retries():
+    t = [0.0]
+    p = RetryPolicy(max_attempts=10, total_deadline_s=1.0)
+    deadline = p.deadline_from_now(clock=lambda: t[0])
+    assert p.may_retry(0, deadline, clock=lambda: t[0])
+    t[0] = 1.5  # budget spent
+    assert not p.may_retry(0, deadline, clock=lambda: t[0])
+
+
+# ---------------------------------------------------------- CircuitBreaker
+def _breaker(**kw):
+    t = [0.0]
+    kw.setdefault("min_calls", 4)
+    kw.setdefault("error_threshold", 0.5)
+    kw.setdefault("open_s", 10.0)
+    br = CircuitBreaker("test:1", clock=lambda: t[0], **kw)
+    return br, t
+
+
+def test_breaker_stays_closed_below_volume():
+    br, _t = _breaker()
+    for _ in range(3):   # below min_calls: even 100% errors don't trip
+        br.allow()
+        br.record(False)
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_stays_closed_below_error_rate():
+    br, _t = _breaker()
+    for _ in range(20):  # plenty of volume, low error rate
+        br.allow()
+        br.record(True)
+    br.allow()
+    br.record(False)
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_opens_fast_fails_half_opens_and_recovers():
+    br, t = _breaker()
+    for ok in (True, False, False, False):   # 75% errors over 4 calls
+        br.allow()
+        br.record(ok)
+    assert br.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+    assert br.stats()["fastFails"] == 1
+    # after open_s: half-open admits ONE probe, fast-fails the second
+    t[0] = 10.5
+    br.allow()
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+    br.record(True)   # probe succeeded -> closed, window reset
+    assert br.state == CircuitBreaker.CLOSED
+    br.allow()
+    br.record(True)
+
+
+def test_breaker_reopens_on_failed_probe():
+    br, t = _breaker()
+    for _ in range(4):
+        br.allow()
+        br.record(False)
+    t[0] = 10.5
+    br.allow()          # the half-open probe
+    br.record(False)    # ...fails
+    assert br.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+    # and the clock must advance ANOTHER open_s before the next probe
+    t[0] = 20.6
+    br.allow()
+    br.record(True)
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_registry_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("PIO_BREAKER_ENABLED", raising=False)
+    assert CircuitBreaker.for_endpoint("a:1") is None
+    monkeypatch.setenv("PIO_BREAKER_ENABLED", "1")
+    CircuitBreaker.reset_registry()
+    try:
+        b1 = CircuitBreaker.for_endpoint("a:1")
+        assert b1 is not None
+        assert CircuitBreaker.for_endpoint("a:1") is b1   # shared
+        assert CircuitBreaker.for_endpoint("b:2") is not b1
+    finally:
+        CircuitBreaker.reset_registry()
+
+
+# ----------------------------------------------------------- FaultInjector
+def test_fault_spec_parsing_rejects_garbage():
+    for bad in ("explode:0.5", "drop", "drop:nan", "drop:1.5", "drop:-1"):
+        with pytest.raises(FaultSpecError):
+            FaultInjector(bad)
+    inj = FaultInjector("drop:0.5, error:0.1:502 @server, latency:1:5")
+    kinds = [f.kind for f in inj.faults]
+    assert kinds == ["drop", "error", "latency"]
+    assert inj.faults[1].scope == "server"
+
+
+def test_injector_drop_and_scope():
+    inj = FaultInjector("drop:1@client")
+    with pytest.raises(InjectedFault):
+        inj.before_send("client", "POST /rpc")
+    # scope mismatch: server boundary unaffected
+    inj.before_send("server", "POST /rpc")
+    assert inj.fired.get("drop") == 1
+
+
+def test_injector_drop_max_fires_one_shot():
+    """drop_rx:1:1 — exactly one lost response, then healed: the
+    deterministic shape of a mid-request server kill."""
+    inj = FaultInjector("drop_rx:1:1")
+    with pytest.raises(InjectedFault):
+        inj.after_send("client", "POST /rpc/read_columns")
+    inj.after_send("client", "POST /rpc/read_columns")  # healed
+    assert inj.fired["drop_rx"] == 1
+
+
+def test_injector_error_and_truncate():
+    inj = FaultInjector("error:1:503")
+    status, payload = inj.on_response("client", "POST /rpc", 200, b"{}")
+    assert status == 503 and b"injected" in payload
+    inj = FaultInjector("truncate:1")
+    status, payload = inj.on_response("client", "GET /x", 200, b"A" * 100)
+    assert status == 200 and len(payload) == 50
+
+
+def test_injector_deterministic_with_seed():
+    a = FaultInjector("drop:0.5", seed=42)
+    b = FaultInjector("drop:0.5", seed=42)
+
+    def decisions(inj):
+        out = []
+        for _ in range(50):
+            try:
+                inj.before_send("client", "GET /")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        return out
+
+    seq = decisions(a)
+    assert seq == decisions(b)
+    assert any(seq) and not all(seq)
+
+
+def test_install_clear_and_env_activation(monkeypatch):
+    resilience.clear()
+    monkeypatch.delenv("PIO_FAULT_SPEC", raising=False)
+    assert resilience.active() is None
+    inj = resilience.install("drop:1")
+    assert resilience.active() is inj
+    resilience.clear()
+    assert resilience.active() is None
+    monkeypatch.setenv("PIO_FAULT_SPEC", "latency:1:1")
+    env_inj = resilience.active()
+    assert env_inj is not None
+    assert resilience.active() is env_inj   # cached per spec value
+    monkeypatch.delenv("PIO_FAULT_SPEC")
+    assert resilience.active() is None
+
+
+# ---------------------------------------------------------- degraded flag
+def test_degraded_flag_scoped_per_thread():
+    resilience.reset_degraded()
+    resilience.note_degraded("a")
+    resilience.note_degraded("b")
+    assert resilience.pop_degraded() == ("a", "b")
+    assert resilience.pop_degraded() == ()   # scope cleared
+
+    # another thread's scope is independent
+    seen = {}
+
+    def other():
+        resilience.reset_degraded()
+        seen["other"] = resilience.pop_degraded()
+
+    resilience.reset_degraded()
+    resilience.note_degraded("mine")
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(5)
+    assert seen["other"] == ()
+    assert resilience.pop_degraded() == ("mine",)
+
+
+def test_note_degraded_outside_scope_only_counts():
+    before = resilience.degraded_total()
+    resilience.pop_degraded()           # ensure no scope on this thread
+    resilience.note_degraded("orphan")  # must not raise
+    assert resilience.degraded_total() == before + 1
