@@ -637,6 +637,145 @@ def measure_concurrent_qps(storage, engine, batching: str,
     return out
 
 
+def measure_telemetry(storage, engine, n_conns: int = 8,
+                      queries_per_client: int = 100):
+    """Telemetry leg (run after the concurrent-QPS leg): the same batched
+    serving path with PIO_TELEMETRY off vs on, then a real HTTP
+    `GET /metrics` scrape whose parsed counters land in the JSON detail
+    (padding-waste ratio, flush-size histogram, retry counts).
+
+    The off leg is the overhead baseline; under BENCH_STRICT_EXTRAS=1 a
+    failed/unparseable scrape, or a metrics-on p99 more than 5% AND
+    0.2 ms above metrics-off (the absolute floor keeps sub-noise deltas
+    from tripping the ratio on a fast CPU path), hard-fails the run."""
+    import http.client
+    import re
+    import socket
+    import threading
+
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+    def leg(telemetry_on: bool):
+        prior = os.environ.get("PIO_TELEMETRY")
+        os.environ["PIO_TELEMETRY"] = "1" if telemetry_on else "0"
+        try:
+            api = QueryAPI(storage=storage, engine=engine,
+                           config=ServerConfig(batching="on"))
+            server = make_server(api, "127.0.0.1", 0)
+            port = server.server_address[1]
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            lat_lock = threading.Lock()
+            lat: list = []
+            errors: list = []
+            barrier = threading.Barrier(n_conns + 1)
+
+            def client(cx):
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    my = []
+                    barrier.wait()
+                    for q in range(queries_per_client):
+                        body = json.dumps(
+                            {"user": f"u{(cx * 131 + q * 17) % 1000}",
+                             "num": 10})
+                        t0 = time.perf_counter()
+                        conn.request(
+                            "POST", "/queries.json", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        my.append(time.perf_counter() - t0)
+                        assert resp.status == 200, payload[:200]
+                    conn.close()
+                    with lat_lock:
+                        lat.extend(my)
+                except Exception as e:
+                    errors.append(e)
+
+            scrape = None
+            try:
+                threads = [threading.Thread(target=client, args=(cx,))
+                           for cx in range(n_conns)]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                if telemetry_on:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.request("GET", "/metrics")
+                    resp = conn.getresponse()
+                    text = resp.read().decode("utf-8")
+                    assert resp.status == 200, "scrape failed"
+                    assert resp.getheader("Content-Type", "").startswith(
+                        "text/plain"), "scrape content type"
+                    conn.close()
+                    inst = api._batcher._inst["batcher"]
+                    scrape = (text, inst)
+            finally:
+                server.shutdown()
+                api.close()
+            lat_ms = np.asarray(lat) * 1e3
+            return {"p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                    }, scrape
+        finally:
+            if prior is None:
+                os.environ.pop("PIO_TELEMETRY", None)
+            else:
+                os.environ["PIO_TELEMETRY"] = prior
+
+    off, _ = leg(False)
+    on, scrape = leg(True)
+    text, inst = scrape
+
+    def samples(family):
+        out = {}
+        for m in re.finditer(
+                rf'^{family}\{{([^}}]*)\}}\s(\S+)$', text, re.M):
+            labels, value = m.groups()
+            if f'batcher="{inst}"' in labels or "batcher" not in labels:
+                out[labels] = float(value)
+        return out
+
+    def label(labels, key):
+        m = re.search(rf'{key}="([^"]+)"', labels)
+        return m.group(1) if m else None
+
+    queries = sum(samples("pio_batcher_queries_total").values())
+    flush_hist = {label(k, "size"): int(v)
+                  for k, v in samples("pio_batcher_batch_size").items()}
+    padded = sum(int(label(k, "bucket")) * v
+                 for k, v in samples("pio_batcher_bucket").items())
+    if queries <= 0 or padded <= 0 or not flush_hist:
+        raise RuntimeError("metrics scrape parsed but the telemetry leg's "
+                           "batcher series are missing")
+    retries = {label(k, "kind"): int(v)
+               for k, v in samples("pio_rpc_retries_total").items()}
+    # overhead gate: relative AND absolute (p99 noise floor)
+    overhead_ok = (on["p99_ms"] <= off["p99_ms"] * 1.05
+                   or on["p99_ms"] - off["p99_ms"] <= 0.2)
+    return {
+        "telemetry_off": off,
+        "telemetry_on": on,
+        "telemetry_overhead_p99_pct": round(
+            (on["p99_ms"] / max(off["p99_ms"], 1e-9) - 1.0) * 100, 2),
+        "telemetry_overhead_ok": bool(overhead_ok),
+        "telemetry_scrape_ok": True,
+        "telemetry_flush_size_hist": dict(sorted(flush_hist.items(),
+                                                 key=lambda kv: int(kv[0]))),
+        "telemetry_padding_waste_ratio": round(1.0 - queries / padded, 4),
+        "telemetry_rpc_retries": retries,
+    }
+
+
 def serve_and_measure(storage, engine, n_queries: int = 200):
     """Deploy via QueryAPI + HTTP and time front-door query round-trips."""
     import http.client
@@ -857,6 +996,17 @@ def main() -> None:
                 throughput = {"serve_throughput_error":
                               f"{type(e).__name__}: {e}"}
 
+        # telemetry leg: metrics-on vs metrics-off p99 through the same
+        # batched path + a real /metrics scrape into the JSON detail
+        # (padding-waste ratio, flush-size histogram, retry counts)
+        telem = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                telem = measure_telemetry(storage, engine)
+            except Exception as e:
+                telem = {"telemetry_error": f"{type(e).__name__}: {e}",
+                         "telemetry_scrape_ok": False}
+
         # parity leg AFTER the timed passes: it reuses the already-compiled
         # hybrid program and adds only the csrb one, so warmup_compile_s
         # above stays an honest per-process compile measurement
@@ -960,6 +1110,7 @@ def main() -> None:
                 "serve_http_p50_ms": round(p50_ms, 3),
                 "serve_http_p99_ms": round(p99_ms, 3),
                 **(throughput or {}),
+                **(telem or {}),
                 **(eval_grid or {}),
                 **(ecom or {}),
                 **(robust or {}),
@@ -1007,6 +1158,19 @@ def main() -> None:
                     failures.append(
                         "breaker opened at a 1% fault rate (threshold "
                         "misconfigured) with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and telem:
+            if not telem.get("telemetry_scrape_ok"):
+                failures.append(
+                    "GET /metrics scrape failed "
+                    f"({telem.get('telemetry_error', 'missing series')}) "
+                    "with BENCH_STRICT_EXTRAS=1")
+            elif not telem.get("telemetry_overhead_ok"):
+                failures.append(
+                    "metrics-on p99 "
+                    f"({telem['telemetry_on']['p99_ms']} ms) exceeds "
+                    "metrics-off "
+                    f"({telem['telemetry_off']['p99_ms']} ms) by >5% "
+                    "with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and (
                 eval_grid or {}).get("eval_error"):
             # by default a crashed eval leg records eval_error and the run

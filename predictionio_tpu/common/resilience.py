@@ -55,6 +55,18 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 logger = logging.getLogger("predictionio_tpu.resilience")
 
 
+def _note_breaker_transition(endpoint: str, to_state: str) -> None:
+    """Mirror a breaker state change into the metrics registry (gated on
+    PIO_TELEMETRY; local import keeps this module usable standalone)."""
+    from predictionio_tpu.common import telemetry
+    if telemetry.on():
+        telemetry.registry().counter(
+            "pio_breaker_transitions_total",
+            "Circuit-breaker state transitions by endpoint",
+            labelnames=("endpoint", "to")).labels(
+                endpoint=endpoint or "?", to=to_state).inc()
+
+
 def _env_float(name: str, default: Optional[float]) -> Optional[float]:
     raw = os.environ.get(name, "")
     if not raw:
@@ -255,6 +267,7 @@ class CircuitBreaker:
                 if now - self._opened_at >= self.open_s:
                     self._state = self.HALF_OPEN
                     self._probes = 0
+                    _note_breaker_transition(self.endpoint, self.HALF_OPEN)
                 else:
                     self._fast_fails += 1
                     raise CircuitOpenError(
@@ -274,11 +287,13 @@ class CircuitBreaker:
                 if ok:  # probe succeeded: close and start fresh
                     self._state = self.CLOSED
                     self._events = []
+                    _note_breaker_transition(self.endpoint, self.CLOSED)
                     logger.info("breaker %s: probe ok, closing",
                                 self.endpoint or "?")
                 else:   # probe failed: back to open for another open_s
                     self._state = self.OPEN
                     self._opened_at = now
+                    _note_breaker_transition(self.endpoint, self.OPEN)
                     logger.warning("breaker %s: probe failed, re-opening",
                                    self.endpoint or "?")
                 return
@@ -290,6 +305,7 @@ class CircuitBreaker:
                     self._state = self.OPEN
                     self._opened_at = now
                     self._opened_total += 1
+                    _note_breaker_transition(self.endpoint, self.OPEN)
                     logger.warning(
                         "breaker %s: OPEN (error rate %.0f%% over %d calls "
                         "in %.0fs window)", self.endpoint or "?",

@@ -1,0 +1,417 @@
+"""Process-wide metrics registry + Prometheus text exposition.
+
+Every daemon and hot path in this framework grew its own ad-hoc counters
+(batcher stats in ``GET /``, ``LAYOUT_STATS``, ``degradedCount``, the
+event server's hourly rotator); none of them were scrapable by standard
+tooling. This module is the single home for all of them: a process-wide
+registry of counters, gauges and fixed-bucket histograms with labels,
+served as Prometheus text exposition (``GET /metrics``) by every daemon
+next to ``/healthz``/``/readyz``.
+
+Design rules, in the order they were traded off:
+
+- **Lock-cheap on the hot path.** Each instrument child owns its own
+  tiny lock; an increment is one short critical section over scalar
+  updates, never a registry-wide lock (the registry lock is taken only
+  when a family or labeled child is first created — the per-endpoint
+  ``CircuitBreaker`` registry pattern from :mod:`resilience`).
+- **Two tiers of recording.** Instruments that back an EXISTING JSON
+  surface (batcher stats, ``degradedCount``, ``LAYOUT_STATS``, the
+  event-server rotator) record unconditionally — they are the source of
+  truth for byte-compatible legacy shapes. NEW instrumentation sites
+  (per-request latency, chunk-decode timings, RPC retries, ...) gate on
+  :func:`on` (``PIO_TELEMETRY=1``), so with telemetry off the added hot-
+  path cost is one cached-dict env lookup and the wire behavior is
+  byte-identical to the pre-telemetry code (asserted by test).
+- **Timing honesty** (KNOWN_ISSUES.md #3): every timed region fed into a
+  histogram here must end in a real host transfer somewhere downstream
+  — never ``block_until_ready``, which can return early on tunneled
+  platforms and silently under-report.
+
+Everything is dependency-free stdlib, safe to import from any layer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import (
+    Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple,
+)
+
+#: default latency buckets (seconds) — sub-ms serving through multi-second
+#: train phases, mirroring prometheus_client's spread but wider at the top
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
+
+_INF = float("inf")
+
+
+def on() -> bool:
+    """Is optional (new-site) telemetry recording enabled?
+
+    ``PIO_TELEMETRY=1`` turns it on; :func:`set_enabled` overrides for
+    tests and the bench. One dict lookup — cheap enough to call on every
+    request without caching games."""
+    if _override is not None:
+        return _override
+    return os.environ.get("PIO_TELEMETRY", "0") == "1"
+
+
+_override: Optional[bool] = None
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force telemetry on/off regardless of env (None = back to env)."""
+    global _override
+    _override = value
+
+
+# ---------------------------------------------------------------------------
+# instruments (children — one per unique label combination)
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """Monotonically-increasing scalar (floats allowed: accumulated
+    seconds are counters too)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _samples(self, name, labels):
+        yield (name, labels, self.value)
+
+
+class Gauge:
+    """Scalar that can go up and down (queue depths, last-seen values)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _samples(self, name, labels):
+        yield (name, labels, self.value)
+
+
+class Histogram:
+    """Fixed-bucket latency/size histogram.
+
+    ``buckets`` are upper bounds (``+Inf`` is implicit). ``observe`` is a
+    linear scan over a short tuple + two adds under the child lock —
+    no allocation, no sorting, hot-path safe."""
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = bs
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bs) + 1)  # +1 = the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for b in self.buckets:        # outside the lock: read-only tuple
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """(cumulative bucket counts keyed by upper bound, sum, count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, acc = [], 0
+        for c in counts:
+            acc += c
+            cum.append(acc)
+        return {"buckets": dict(zip(list(self.buckets) + [_INF], cum)),
+                "sum": s, "count": total}
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def _samples(self, name, labels):
+        snap = self.snapshot()
+        for ub, c in snap["buckets"].items():
+            le = "+Inf" if ub == _INF else _fmt_number(ub)
+            yield (name + "_bucket", labels + (("le", le),), c)
+        yield (name + "_sum", labels, snap["sum"])
+        yield (name + "_count", labels, snap["count"])
+
+
+# ---------------------------------------------------------------------------
+# families (one per metric name; children per label combination)
+# ---------------------------------------------------------------------------
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Family:
+    """All children of one metric name, e.g. every labeled series of
+    ``pio_rpc_retries_total``."""
+
+    def __init__(self, name: str, help_: str, kind: str,
+                 labelnames: Tuple[str, ...],
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self.labelnames = labelnames
+        self._buckets = buckets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def _make_child(self):
+        if self.kind == "histogram":
+            return Histogram(self._buckets or DEFAULT_BUCKETS)
+        return _KINDS[self.kind]()
+
+    def labels(self, **labelvalues: str):
+        """The child for this label combination (created on first use)."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        child = self._children.get(key)   # racy get: dict reads are safe
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._make_child()
+                    self._children[key] = child
+        return child
+
+    def child(self):
+        """The single unlabeled child (labelnames must be empty)."""
+        if self.labelnames:
+            raise ValueError(f"metric {self.name} requires labels "
+                             f"{self.labelnames}")
+        return self.labels()
+
+    def samples(self) -> Iterable[Tuple[str, Tuple, float]]:
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            labels = tuple(zip(self.labelnames, key))
+            yield from child._samples(self.name, labels)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _fmt_number(v: float) -> str:
+    if v == _INF:
+        return "+Inf"
+    if v == -_INF:
+        return "-Inf"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class MetricsRegistry:
+    """Process-wide instrument registry + Prometheus text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, Family] = {}
+        #: scrape-time collectors: callables yielding raw exposition lines
+        #: (used by surfaces whose source of truth must stay windowed,
+        #: e.g. the event server's hourly StatsBook). Held weakly when
+        #: bound methods so throwaway daemons don't accumulate forever.
+        self._collectors: List[Any] = []
+
+    # ------------------------------------------------------------ factories
+    def _family(self, name: str, help_: str, kind: str,
+                labelnames: Sequence[str],
+                buckets: Optional[Sequence[float]] = None) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = Family(name, help_, kind, tuple(labelnames),
+                             buckets=buckets)
+                self._families[name] = fam
+            elif fam.kind != kind or fam.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name} already registered as {fam.kind}"
+                    f"{fam.labelnames}, not {kind}{tuple(labelnames)}")
+            return fam
+
+    def counter(self, name: str, help_: str = "",
+                labelnames: Sequence[str] = ()) -> Family:
+        return self._family(name, help_, "counter", labelnames)
+
+    def gauge(self, name: str, help_: str = "",
+              labelnames: Sequence[str] = ()) -> Family:
+        return self._family(name, help_, "gauge", labelnames)
+
+    def histogram(self, name: str, help_: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Family:
+        return self._family(name, help_, "histogram", labelnames,
+                            buckets=buckets)
+
+    def register_collector(self, fn: Callable[[], Iterable[str]]) -> None:
+        """Register a scrape-time line producer. Bound methods are held
+        via weakref so a garbage-collected owner silently drops out."""
+        ref: Any
+        if hasattr(fn, "__self__"):
+            ref = weakref.WeakMethod(fn)
+        else:
+            ref = fn
+        with self._lock:
+            self._collectors.append(ref)
+
+    # ----------------------------------------------------------- exposition
+    def exposition(self) -> str:
+        """The registry in Prometheus text format 0.0.4."""
+        out: List[str] = []
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+            collectors = list(self._collectors)
+        for fam in families:
+            if fam.help:
+                out.append(f"# HELP {fam.name} {fam.help}")
+            out.append(f"# TYPE {fam.name} {fam.kind}")
+            for name, labels, value in fam.samples():
+                if labels:
+                    lab = ",".join(
+                        f'{k}="{_escape_label(v)}"' for k, v in labels)
+                    out.append(f"{name}{{{lab}}} {_fmt_number(value)}")
+                else:
+                    out.append(f"{name} {_fmt_number(value)}")
+        dead = []
+        for ref in collectors:
+            fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
+            if fn is None:
+                dead.append(ref)
+                continue
+            try:
+                out.extend(fn())
+            except Exception:      # a broken collector must not kill scrapes
+                continue
+        if dead:
+            with self._lock:
+                self._collectors = [c for c in self._collectors
+                                    if c not in dead]
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        """Drop every family and collector (tests)."""
+        with self._lock:
+            self._families.clear()
+            self._collectors.clear()
+
+
+#: the process-wide registry every instrumentation site shares
+REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+class RegistryDict:
+    """dict-like view over one counter family's labeled children — lets a
+    legacy module-level stats dict (``LAYOUT_STATS["hits"] += 1``) become
+    registry-backed without changing a single call site."""
+
+    def __init__(self, family: Family, labelname: str, keys: Sequence[str]):
+        self._children = {k: family.labels(**{labelname: k}) for k in keys}
+
+    def __getitem__(self, key: str) -> int:
+        return int(self._children[key].value)
+
+    def __setitem__(self, key: str, value: float) -> None:
+        child = self._children[key]
+        child.inc(value - child.value)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._children
+
+    def keys(self):
+        return self._children.keys()
+
+    def items(self):
+        return [(k, int(c.value)) for k, c in self._children.items()]
+
+
+# ---------------------------------------------------------------------------
+# shared daemon routes: GET /metrics and GET /traces.json
+# ---------------------------------------------------------------------------
+
+#: Prometheus text exposition content type
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def handle_route(method: str, path: str):
+    """Serve ``GET /metrics`` / ``GET /traces.json`` for any daemon's
+    route handler; returns None when the request is not a telemetry
+    route (the handler continues with its own table). Unauthenticated by
+    design, like ``/healthz`` — the payload is operational counters, not
+    data."""
+    if method != "GET":
+        return None
+    if path == "/metrics":
+        return 200, REGISTRY.exposition(), {
+            "Content-Type": EXPOSITION_CONTENT_TYPE}
+    if path == "/traces.json":
+        from predictionio_tpu.common import tracing
+        return 200, tracing.snapshot()
+    return None

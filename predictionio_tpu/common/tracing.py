@@ -1,0 +1,257 @@
+"""Request tracing: where did this query's 40 ms go?
+
+Dapper-style per-request traces (Sigelman et al., 2010) across the three
+daemons: a trace is born at the first server that sees a request (when
+``PIO_TRACE=1``), rides thread-local context through the serving stack
+(admission → flush → dispatch), and crosses process boundaries in an
+``X-PIO-Trace: <trace_id>-<span_id>`` header on outbound storage RPCs —
+exactly the ``X-PIO-Deadline-Ms`` plumbing pattern in
+``data/storage/remote.py`` / ``data/api/http.py``. A server that
+RECEIVES the header always adopts it (recording spans for an already-
+sampled request costs nothing on the wire), but only ORIGINATES new
+traces when ``PIO_TRACE=1``, so the default wire behavior — no header,
+no spans — is byte-identical to the pre-tracing code.
+
+Spans land in a bounded process-wide ring buffer (``PIO_TRACE_BUFFER``,
+default 512 spans — old spans fall off; this is a flight recorder, not a
+TSDB) served by ``GET /traces.json`` on every daemon.
+
+Clocking: span durations are ``time.perf_counter`` deltas; the absolute
+timestamp is taken once per span from the wall clock for display only.
+Any span that times device work must end in a real host transfer
+(KNOWN_ISSUES.md #3) — same rule as every other timed region here.
+
+Dependency-free stdlib; safe to import from any layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime as _dt
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+#: the propagation header (title-case for emission; matching is
+#: case-insensitive like every other header in data/api/http.py)
+TRACE_HEADER = "X-PIO-Trace"
+
+
+def enabled() -> bool:
+    """May this process ORIGINATE traces? (Adoption of an incoming
+    header is always on — it costs nothing when nobody sends one.)"""
+    if _override is not None:
+        return _override
+    return os.environ.get("PIO_TRACE", "0") == "1"
+
+
+_override: Optional[bool] = None
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force origination on/off regardless of env (None = back to env)."""
+    global _override
+    _override = value
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The (trace, parent span) a unit of work belongs to."""
+    trace_id: str
+    span_id: str
+
+    def header_value(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+
+@dataclass(frozen=True)
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    service: str
+    start_ts: float      # wall-clock epoch seconds (display only)
+    duration_s: float    # perf_counter delta (authoritative)
+
+
+class _Ring:
+    def __init__(self, cap: int):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=cap)
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen or 0
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._buf.append(span)
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+def _buffer_cap() -> int:
+    raw = os.environ.get("PIO_TRACE_BUFFER", "")
+    try:
+        return max(16, int(raw)) if raw else 512
+    except ValueError:
+        return 512
+
+
+_ring = _Ring(_buffer_cap())
+_tls = threading.local()
+
+
+def clear() -> None:
+    """Drop every recorded span (tests)."""
+    _ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# context plumbing
+# ---------------------------------------------------------------------------
+
+def current() -> Optional[TraceContext]:
+    """This thread's active trace context, or None (the common case —
+    one getattr, the whole cost of tracing-off)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as this thread's context for the block (None is
+    allowed and simply clears it — callers never need to branch)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+def new_context(trace_id: Optional[str] = None) -> TraceContext:
+    return TraceContext(trace_id or _new_id(), _new_id())
+
+
+def parse_header(value: Optional[str]) -> Optional[TraceContext]:
+    """``trace_id-span_id`` → context; malformed values are ignored (a
+    bad header must never fail the request it rode in on)."""
+    if not value:
+        return None
+    trace_id, _, span_id = value.strip().partition("-")
+    if not trace_id or not span_id:
+        return None
+    return TraceContext(trace_id, span_id)
+
+
+def server_context(headers: Optional[Dict[str, str]]) -> \
+        Optional[TraceContext]:
+    """The context an incoming request should run under: the propagated
+    header's (always adopted), else a fresh root when origination is on,
+    else None."""
+    if headers:
+        for k, v in headers.items():
+            if k.lower() == "x-pio-trace":
+                ctx = parse_header(v)
+                if ctx is not None:
+                    return ctx
+                break
+    if enabled():
+        return new_context()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# span recording
+# ---------------------------------------------------------------------------
+
+def _wall_now() -> float:
+    # wall clock for display; durations always come from perf_counter
+    return _dt.datetime.now(_dt.timezone.utc).timestamp()
+
+
+@contextlib.contextmanager
+def span(name: str, service: str = ""):
+    """Record a child span of the active context around the block.
+
+    No active context -> pure pass-through (one getattr); the block runs
+    untouched. The child becomes the active context inside the block, so
+    nested spans and outbound RPC headers chain correctly."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        yield None
+        return
+    child = TraceContext(ctx.trace_id, _new_id())
+    prev = ctx
+    _tls.ctx = child
+    wall = _wall_now()
+    t0 = time.perf_counter()
+    try:
+        yield child
+    finally:
+        dt = time.perf_counter() - t0
+        _tls.ctx = prev
+        _ring.add(Span(
+            trace_id=child.trace_id, span_id=child.span_id,
+            parent_id=prev.span_id, name=name, service=service,
+            start_ts=wall, duration_s=dt))
+
+
+def record_span(name: str, ctx: Optional[TraceContext],
+                duration_s: float, service: str = "") -> None:
+    """Record a completed span with an explicit duration under ``ctx``
+    (for work timed on another thread, e.g. the batcher's per-item
+    admission wait). No-op when ctx is None."""
+    if ctx is None:
+        return
+    _ring.add(Span(
+        trace_id=ctx.trace_id, span_id=_new_id(), parent_id=ctx.span_id,
+        name=name, service=service,
+        start_ts=_wall_now() - duration_s, duration_s=duration_s))
+
+
+# ---------------------------------------------------------------------------
+# /traces.json
+# ---------------------------------------------------------------------------
+
+def snapshot(limit: int = 64) -> Dict[str, Any]:
+    """Ring-buffer contents grouped by trace, newest trace first."""
+    spans = _ring.spans()
+    by_trace: Dict[str, List[Span]] = {}
+    order: List[str] = []
+    for s in spans:
+        if s.trace_id not in by_trace:
+            by_trace[s.trace_id] = []
+            order.append(s.trace_id)
+        by_trace[s.trace_id].append(s)
+    traces = []
+    for tid in reversed(order[-limit:]):
+        ss = sorted(by_trace[tid], key=lambda s: s.start_ts)
+        traces.append({
+            "traceId": tid,
+            "spans": [{
+                "spanId": s.span_id,
+                "parentId": s.parent_id,
+                "name": s.name,
+                "service": s.service,
+                "startMs": round(s.start_ts * 1e3, 3),
+                "durationMs": round(s.duration_s * 1e3, 3),
+            } for s in ss],
+        })
+    return {"originate": enabled(), "capacity": _ring.capacity,
+            "spanCount": len(spans), "traces": traces}

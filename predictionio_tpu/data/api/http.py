@@ -13,11 +13,12 @@ from __future__ import annotations
 import json
 import signal
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
 
-from predictionio_tpu.common import resilience
+from predictionio_tpu.common import resilience, telemetry, tracing
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -44,15 +45,37 @@ class _Handler(BaseHTTPRequestHandler):
             except ConnectionError:
                 self.close_connection = True
                 return   # no response bytes at all: a mid-request kill
+        # request telemetry rides the transport so every daemon gets it
+        # uniformly: an incoming X-PIO-Trace header is always adopted (the
+        # upstream already sampled this request); fresh traces originate
+        # only under PIO_TRACE=1, so default wire behavior is unchanged.
+        headers = dict(self.headers.items())
+        ctx = tracing.server_context(headers)
+        service = type(self.api).__name__
+        t0 = time.perf_counter() if telemetry.on() else None
         try:
-            response = self.api.handle(
-                method, parsed.path, query, body, dict(self.headers.items()))
+            with tracing.activate(ctx):
+                with tracing.span(f"server:{parsed.path}", service=service):
+                    response = self.api.handle(
+                        method, parsed.path, query, body, headers)
             if len(response) == 3:
                 status, payload, extra_headers = response
             else:
                 status, payload = response
         except Exception as e:  # handler without its own guard
             status, payload = 500, {"message": str(e)}
+        if t0 is not None:
+            telemetry.registry().histogram(
+                "pio_http_request_seconds",
+                "HTTP request handling latency by daemon and method",
+                labelnames=("service", "method")).labels(
+                    service=service, method=method
+            ).observe(time.perf_counter() - t0)
+            telemetry.registry().counter(
+                "pio_http_requests_total",
+                "HTTP requests served by daemon and status",
+                labelnames=("service", "status")).labels(
+                    service=service, status=str(status)).inc()
         if isinstance(payload, (bytes, bytearray)):  # binary (storage RPC)
             data = bytes(payload)
             ctype = "application/octet-stream"
@@ -71,6 +94,11 @@ class _Handler(BaseHTTPRequestHandler):
                     {"message": "response contains non-finite numbers"}
                 ).encode("utf-8")
             ctype = "application/json; charset=UTF-8"
+        if extra_headers and "Content-Type" in extra_headers:
+            # handler-chosen content type (GET /metrics serves Prometheus
+            # text exposition, which is a str but not text/html)
+            extra_headers = dict(extra_headers)
+            ctype = extra_headers.pop("Content-Type")
         content_length = len(data)
         if inj is not None:
             new_status, new_data = inj.on_response(

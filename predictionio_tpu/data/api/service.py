@@ -155,6 +155,10 @@ class EventAPI:
             return 200, {"status": "alive"}
         if path == "/healthz" and method == "GET":
             return 200, {"status": "ok"}
+        from predictionio_tpu.common import telemetry
+        t = telemetry.handle_route(method, path)
+        if t is not None:       # GET /metrics (Prometheus) / /traces.json
+            return t
         if path == "/readyz" and method == "GET":
             if self.draining:
                 return 503, {"status": "draining"}

@@ -57,13 +57,47 @@ def _hour_floor(t: _dt.datetime) -> _dt.datetime:
 
 
 class StatsBook:
-    """Hourly-rotating stats (StatsActor.scala:45-79), thread-safe."""
+    """Hourly-rotating stats (StatsActor.scala:45-79), thread-safe.
+
+    Registry integration: the book registers itself as a scrape-time
+    COLLECTOR with the process metrics registry (common/telemetry.py) —
+    `GET /metrics` exposes the long-lived counters as
+    ``pio_events_requests_total`` / ``pio_events_ingested_total`` while
+    the hourly rotation (which plain monotonic counters cannot express)
+    stays here, so the ``/stats.json`` JSON shape is byte-identical to
+    before. The registry holds the book weakly; a throwaway EventAPI's
+    book drops out of scrapes when it is garbage-collected."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.longlive = Stats()
         self.hourly = Stats(_hour_floor(utcnow()))
         self.prev_hourly: Optional[Stats] = None
+        from predictionio_tpu.common import telemetry
+        telemetry.registry().register_collector(self.collect_metrics)
+
+    def collect_metrics(self):
+        """Prometheus exposition lines for the long-lived window."""
+        from predictionio_tpu.common.telemetry import _escape_label
+        with self._lock:
+            status = dict(self.longlive.status_code_count)
+            ete = dict(self.longlive.ete_count)
+        if not status and not ete:
+            return []     # idle books add no scrape noise
+        out = ["# TYPE pio_events_requests_total counter"]
+        for (app_id, code), n in sorted(status.items()):
+            out.append(
+                f'pio_events_requests_total{{app_id="{app_id}",'
+                f'status="{code}"}} {n}')
+        out.append("# TYPE pio_events_ingested_total counter")
+        for (app_id, et, tet, ev), n in sorted(
+                ete.items(), key=lambda kv: str(kv[0])):
+            out.append(
+                f'pio_events_ingested_total{{app_id="{app_id}",'
+                f'entity_type="{_escape_label(et or "")}",'
+                f'target_entity_type="{_escape_label(tet or "")}",'
+                f'event="{_escape_label(ev or "")}"}} {n}')
+        return out
 
     def bookkeeping(self, app_id: int, status_code: int, event: Event) -> None:
         with self._lock:

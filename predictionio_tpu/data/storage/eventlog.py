@@ -1146,6 +1146,11 @@ class EventlogEvents(Events):
         from the chunk's cached column dict when the serving LRU already
         holds it (``__extra_offsets__`` is precomputed there) instead of
         re-running the cumsum over the whole chunk per read."""
+        from predictionio_tpu.common import telemetry
+        t0 = None
+        if telemetry.on():
+            import time as _t
+            t0 = _t.perf_counter()
         nc = "nc_" + rating_property
         with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
             mask = np.ones(data["event"].shape[0], dtype=bool)
@@ -1181,13 +1186,21 @@ class EventlogEvents(Events):
                             r[out_ix] = float(v)
                     except (ValueError, TypeError):
                         pass
-            return {
+            out = {
                 "entity_code": data["entity_id"][mask],
                 "target_code": data["target_id"][mask],
                 "event_code": data["event"][mask],
                 "rating": r,
                 "time_ms": data["time_ms"][mask],
             }
+        if t0 is not None:
+            import time as _t
+            telemetry.registry().histogram(
+                "pio_read_chunk_decode_seconds",
+                "Per-chunk columnar decode (npz load + filter + string-"
+                "rating side-channel) on the bulk-read pool").labels(
+            ).observe(_t.perf_counter() - t0)
+        return out
 
     @staticmethod
     def _encode_buffer_tail(

@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
-from predictionio_tpu.common import resilience
+from predictionio_tpu.common import resilience, telemetry, tracing
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
@@ -345,6 +345,9 @@ class StorageRPCAPI:
             return 200, {"status": "ok"}
         if method == "GET" and path == "/readyz":
             return self._readyz()
+        t = telemetry.handle_route(method, path)
+        if t is not None:       # GET /metrics (Prometheus) / /traces.json
+            return t
         if self.key and not hmac.compare_digest(
                 headers.get("x-pio-storage-key", "").encode(
                     "utf-8", "surrogateescape"),
@@ -441,6 +444,14 @@ class StorageRPCAPI:
 # client driver
 # --------------------------------------------------------------------------
 
+def _rpc_retries():
+    """Lazy family handle (created on first retry, not at import)."""
+    return telemetry.registry().counter(
+        "pio_rpc_retries_total",
+        "Remote-driver retries by kind (transport reconnects vs 5xx)",
+        labelnames=("kind",))
+
+
 class StorageClient:
     """props: URL (http://host:port or https://host:port)
     [+ KEY, TIMEOUT, CAFILE, VERIFY=false].
@@ -535,7 +546,20 @@ class StorageClient:
         """One RPC through the full resilience stack: breaker gate, fault
         injection, bounded idempotency-aware retries with full-jitter
         backoff, per-attempt deadline header, Retry-After-floored 5xx
-        retry. Returns (status, payload_bytes, response_headers)."""
+        retry. Returns (status, payload_bytes, response_headers).
+
+        Tracing: when the calling thread carries a trace context, the
+        whole RPC (all attempts) records a ``storage`` span and each
+        attempt propagates ``X-PIO-Trace`` so the storage server's spans
+        join the same trace — the exact X-PIO-Deadline-Ms pattern. With
+        no active context no header is added: wire bytes identical."""
+        if tracing.current() is None:
+            return self._attempts(method, path, body, headers, idempotent)
+        with tracing.span("storage", service=f"{self.host}:{self.port}"):
+            return self._attempts(method, path, body, headers, idempotent)
+
+    def _attempts(self, method: str, path: str, body: bytes,
+                  headers: Dict[str, str], idempotent: bool):
         route = f"{method} {path}"
         deadline = self.policy.deadline_from_now()
         attempt = 0
@@ -552,6 +576,9 @@ class StorageClient:
                     remaining_ms = int((deadline - time.monotonic()) * 1e3)
                     hdrs = {**headers,
                             "X-PIO-Deadline-Ms": str(max(0, remaining_ms))}
+                ctx = tracing.current()
+                if ctx is not None:   # propagate the trace across the wire
+                    hdrs = {**hdrs, tracing.TRACE_HEADER: ctx.header_value()}
                 conn = self._conn()
                 conn.request(method, path, body=body, headers=hdrs)
                 if inj is not None:
@@ -582,6 +609,8 @@ class StorageClient:
                 if not (idempotent
                         and self.policy.may_retry(attempt, deadline)):
                     raise
+                if telemetry.on():
+                    _rpc_retries().labels(kind="transport").inc()
                 time.sleep(self.policy.backoff_s(attempt))
                 attempt += 1
                 continue
@@ -594,6 +623,8 @@ class StorageClient:
                     floor = float(rheaders.get("retry-after") or 0.0)
                 except ValueError:
                     floor = 0.0
+                if telemetry.on():
+                    _rpc_retries().labels(kind="status").inc()
                 time.sleep(self.policy.backoff_s(attempt, floor=floor))
                 attempt += 1
                 continue
@@ -625,6 +656,13 @@ class StorageClient:
             raise RuntimeError(
                 f"storage server error {status}: "
                 f"{out.get('message', '')}")
+        if out.get("deduped") and telemetry.on():
+            # the server replayed a stored reply for a retried write —
+            # the exactly-once path actually fired
+            telemetry.registry().counter(
+                "pio_rpc_dedup_replays_total",
+                "Write retries answered from the server's dedup cache "
+                "(exactly-once replays)").child().inc()
         return out.get("result")
 
     def proto(self) -> int:

@@ -100,7 +100,19 @@ def _big_layout_store(td, use_mesh: bool, data, crc=None) -> None:
 #: layout-reuse instrumentation: hits = a train (or prepare_layout) served
 #: its device layout from either cache tier; builds = prepare_ratings ran.
 #: The bench's eval-grid leg reports the delta as `eval_grid_reuse_hits`.
-LAYOUT_STATS = {"hits": 0, "builds": 0}
+#: Registry-backed (common/telemetry.py): the counters live in the
+#: process metrics registry (`pio_layout_cache_total{result=...}` on
+#: GET /metrics); this dict-like view keeps every existing call site
+#: (`LAYOUT_STATS["hits"] += 1`, the bench's delta reads) byte-compatible.
+from predictionio_tpu.common import telemetry as _telemetry
+
+LAYOUT_STATS = _telemetry.RegistryDict(
+    _telemetry.registry().counter(
+        "pio_layout_cache_total",
+        "Device COO layout requests by outcome (hit = served from a "
+        "cache tier, build = prepare_ratings ran)",
+        labelnames=("result",)),
+    "result", ("hits", "builds"))
 
 
 def staging_wanted() -> bool:

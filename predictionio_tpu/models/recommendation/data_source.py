@@ -127,8 +127,12 @@ class DataSource(BaseDataSource):
             stage=als_algorithm.staging_wanted(),
         )
         # sub-phase visibility: store scan vs vocab-encode inside "read"
-        sink = getattr(ctx, "phase_seconds", None)
-        if sink is not None:
+        # (note_phase also mirrors into the metrics registry)
+        if hasattr(ctx, "note_phase"):
+            for k, v in timings.items():
+                ctx.note_phase(k, v)
+        elif getattr(ctx, "phase_seconds", None) is not None:
+            sink = ctx.phase_seconds
             for k, v in timings.items():
                 sink[k] = sink.get(k, 0.0) + v
         return training_data_from_columnar(col)

@@ -89,6 +89,17 @@ class ColumnStager:
             jax.device_put(np.ascontiguousarray(chunk["event_code"])),
             jax.device_put(np.ascontiguousarray(chunk["rating"])),
         ))
+        from predictionio_tpu.common import telemetry
+        if telemetry.on():
+            reg = telemetry.registry()
+            reg.counter(
+                "pio_staging_chunks_total",
+                "COO chunks staged to device during the overlapped read"
+            ).labels().inc()
+            reg.counter(
+                "pio_staging_rows_total",
+                "COO rows staged to device during the overlapped read"
+            ).labels().inc(int(chunk["entity_code"].shape[0]))
 
     def finalize(self, e_lut: np.ndarray, t_lut: np.ndarray,
                  name_lut: np.ndarray) -> Optional[StagedColumns]:
@@ -97,6 +108,11 @@ class ColumnStager:
         produced no rows."""
         if not self._chunks:
             return None
+        from predictionio_tpu.common import telemetry
+        t0 = None
+        if telemetry.on():
+            import time as _t
+            t0 = _t.perf_counter()
         import jax
         import jax.numpy as jnp
         e_lut_d = jax.device_put(np.asarray(e_lut, np.int32))
@@ -116,9 +132,22 @@ class ColumnStager:
             rs.append(r)
         self._chunks = []   # free the raw staging buffers after remap
         one = len(es) == 1
-        return StagedColumns(
+        out = StagedColumns(
             entity_idx=es[0] if one else jnp.concatenate(es),
             target_idx=ts[0] if one else jnp.concatenate(ts),
             event_name_idx=ns[0] if one else jnp.concatenate(ns),
             rating=rs[0] if one else jnp.concatenate(rs),
         )
+        if t0 is not None:
+            import time as _t
+            # ENQUEUE time only: the dispatches above are async, and this
+            # deliberately does NOT add a sync — the in-flight transfers
+            # are absorbed by the layout phase, whose one-element
+            # jax.device_get barrier is the honest clock (KNOWN_ISSUES #3)
+            telemetry.registry().histogram(
+                "pio_staging_finalize_enqueue_seconds",
+                "Device-side remap/concat ENQUEUE time (async; the real "
+                "transfer cost lands in pio_train_phase_seconds{phase="
+                "'layout'}, which ends in a host transfer)").labels(
+            ).observe(_t.perf_counter() - t0)
+        return out

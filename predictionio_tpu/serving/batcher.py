@@ -13,19 +13,44 @@ Admission control: when the queue already holds `max_queue` items,
 stays bounded and the caller maps it to 503 with a Retry-After hint
 derived from the observed drain rate.
 
-Stats are kept under the same condition lock (they are a handful of
-scalar updates per BATCH, not per query): batch-size and padding-bucket
-histograms, queue-wait vs flush (device) time, and rejection counts —
-surfaced by the engine server's `GET /` status route.
+Stats are REGISTRY-BACKED (common/telemetry.py): batch/query/reject
+counts, batch-size and padding-bucket histograms, queue-wait totals and
+flush (device) latency live as labeled instruments in the process-wide
+metrics registry — `GET /metrics` scrapes them and the engine server's
+`GET /` status route derives its byte-compatible legacy JSON from the
+same instruments (single source of truth). Each batcher instance gets
+its own label so a /reload's fresh batcher starts from zero exactly as
+the old per-instance counters did. Updates stay a handful of scalar
+bumps per BATCH, not per query.
+
+Tracing (common/tracing.py): when a submitting request carries a trace
+context, the batch records an `admission` span per item (enqueue → batch
+formation) and a `flush` span around the flush callback, parented on the
+head item's trace so a propagated trace shows admission → flush →
+dispatch → storage end to end. Flush timing honesty: the batched predict
+path ends in a real host transfer (jax.device_get of the top-k result),
+per KNOWN_ISSUES.md #3 — the flush span/histogram would under-report on
+tunneled platforms if that ever regressed to block_until_ready.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from predictionio_tpu.common import telemetry, tracing
 from predictionio_tpu.serving.protocol import bucket_for, pad_buckets
+
+#: distinguishes concurrently-live batchers (e.g. across /reload) in the
+#: process-wide registry; the label value is f"{name}#{seq}"
+_instance_seq = itertools.count()
+
+#: flush latency buckets: sub-ms CPU flushes through multi-second
+#: tunneled-device dispatches
+_FLUSH_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
 
 class ServerSaturated(Exception):
@@ -38,14 +63,19 @@ class ServerSaturated(Exception):
 
 
 class _Pending:
-    __slots__ = ("item", "t_enq", "done", "result", "error")
+    __slots__ = ("item", "t_enq", "done", "result", "error", "trace")
 
-    def __init__(self, item: Any, t_enq: float):
+    def __init__(self, item: Any, t_enq: float,
+                 trace: Optional["tracing.TraceContext"] = None):
         self.item = item
         self.t_enq = t_enq
         self.done = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        #: the submitting request's trace context: the worker thread
+        #: records this item's admission span under it and parents the
+        #: batch's flush span on the head item's
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -62,6 +92,7 @@ class MicroBatcher:
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         self._flush_fn = flush_fn
+        self.name = name
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -69,14 +100,43 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._q: List[_Pending] = []
         self._closed = False
-        # stats (all guarded by _cond)
-        self._batches = 0
-        self._queries = 0
-        self._rejected = 0
-        self._size_hist: Dict[int, int] = {}
-        self._bucket_hist: Dict[int, int] = {}
-        self._queue_wait_s = 0.0
-        self._flush_s = 0.0
+        # stats: registry-backed (single source of truth for BOTH
+        # `GET /metrics` and the engine server's `GET /` legacy JSON).
+        # One label per batcher instance so a fresh batcher — /reload, a
+        # test — starts from zero like the old per-instance counters.
+        reg = telemetry.registry()
+        inst = {"batcher": f"{name}#{next(_instance_seq)}"}
+        self._m_batches = reg.counter(
+            "pio_batcher_batches_total", "Flushed batches",
+            labelnames=("batcher",)).labels(**inst)
+        self._m_queries = reg.counter(
+            "pio_batcher_queries_total", "Queries admitted into batches",
+            labelnames=("batcher",)).labels(**inst)
+        self._m_rejected = reg.counter(
+            "pio_batcher_rejected_total",
+            "Queries rejected by admission control (503)",
+            labelnames=("batcher",)).labels(**inst)
+        self._m_queue_wait = reg.counter(
+            "pio_batcher_queue_wait_seconds_total",
+            "Summed per-query queue wait", labelnames=("batcher",)
+        ).labels(**inst)
+        self._m_flush = reg.histogram(
+            "pio_batcher_flush_seconds",
+            "Flush (device dispatch) latency per batch; the timed region "
+            "ends in a real host transfer (KNOWN_ISSUES #3)",
+            labelnames=("batcher",), buckets=_FLUSH_BUCKETS).labels(**inst)
+        self._m_depth = reg.gauge(
+            "pio_batcher_queue_depth", "Current admission queue depth",
+            labelnames=("batcher",)).labels(**inst)
+        self._size_fam = reg.counter(
+            "pio_batcher_batch_size", "Batches by exact flush size",
+            labelnames=("batcher", "size"))
+        self._bucket_fam = reg.counter(
+            "pio_batcher_bucket", "Batches by padding-bucket occupancy",
+            labelnames=("batcher", "bucket"))
+        self._inst = inst
+        self._size_children: Dict[int, Any] = {}
+        self._bucket_children: Dict[int, Any] = {}
         self._worker = threading.Thread(
             target=self._run, name=name, daemon=True)
         self._worker.start()
@@ -88,14 +148,16 @@ class MicroBatcher:
         Raises ServerSaturated when the queue is full and re-raises any
         exception the flush callback raised for this item's batch.
         """
+        trace = tracing.current()
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             if len(self._q) >= self.max_queue:
-                self._rejected += 1
+                self._m_rejected.inc()
                 raise ServerSaturated(self._retry_after_locked())
-            pending = _Pending(item, time.monotonic())
+            pending = _Pending(item, time.monotonic(), trace=trace)
             self._q.append(pending)
+            self._m_depth.set(len(self._q))
             self._cond.notify_all()
         pending.done.wait()
         if pending.error is not None:
@@ -104,8 +166,9 @@ class MicroBatcher:
 
     def _retry_after_locked(self) -> int:
         """Drain-time estimate for the current backlog, floored at 1s."""
-        if self._batches:
-            per_batch = self._flush_s / self._batches
+        batches = self._m_flush.count
+        if batches:
+            per_batch = self._m_flush.sum / batches
             est = (len(self._q) / self.max_batch_size + 1.0) * per_batch
         else:
             est = 1.0
@@ -131,17 +194,27 @@ class MicroBatcher:
                 batch = self._q[:self.max_batch_size]
                 del self._q[:len(batch)]
                 now = time.monotonic()
-                self._batches += 1
-                self._queries += len(batch)
-                self._size_hist[len(batch)] = \
-                    self._size_hist.get(len(batch), 0) + 1
+                self._m_batches.inc()
+                self._m_queries.inc(len(batch))
+                self._size_child(len(batch)).inc()
                 bucket = bucket_for(len(batch), self.buckets)
-                self._bucket_hist[bucket] = \
-                    self._bucket_hist.get(bucket, 0) + 1
-                self._queue_wait_s += sum(now - p.t_enq for p in batch)
+                self._bucket_child(bucket).inc()
+                self._m_queue_wait.inc(sum(now - p.t_enq for p in batch))
+                self._m_depth.set(len(self._q))
+            # per-item admission spans: enqueue -> batch formation, under
+            # each submitter's own trace (the wait happened off-thread)
+            head_ctx = None
+            for p in batch:
+                if p.trace is not None:
+                    if head_ctx is None:
+                        head_ctx = p.trace
+                    tracing.record_span("admission", p.trace,
+                                        now - p.t_enq, service=self.name)
             t0 = time.monotonic()
             try:
-                results = self._flush_fn([p.item for p in batch])
+                with tracing.activate(head_ctx):
+                    with tracing.span("flush", service=self.name):
+                        results = self._flush_fn([p.item for p in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"flush returned {len(results)} results for a "
@@ -151,11 +224,23 @@ class MicroBatcher:
             except BaseException as e:  # propagate to every waiter
                 for p in batch:
                     p.error = e
-            dt = time.monotonic() - t0
-            with self._cond:
-                self._flush_s += dt
+            self._m_flush.observe(time.monotonic() - t0)
             for p in batch:
                 p.done.set()
+
+    def _size_child(self, n: int):
+        c = self._size_children.get(n)
+        if c is None:
+            c = self._size_fam.labels(size=str(n), **self._inst)
+            self._size_children[n] = c
+        return c
+
+    def _bucket_child(self, b: int):
+        c = self._bucket_children.get(b)
+        if c is None:
+            c = self._bucket_fam.labels(bucket=str(b), **self._inst)
+            self._bucket_children[b] = c
+        return c
 
     # ---------------------------------------------------------------- admin
     def depth(self) -> int:
@@ -171,22 +256,31 @@ class MicroBatcher:
         self._worker.join(timeout)
 
     def stats(self) -> Dict[str, Any]:
+        """The legacy `GET /` JSON shape, derived from the registry
+        instruments (byte-compatible: same keys, same arithmetic)."""
         with self._cond:
-            return {
-                "maxBatchSize": self.max_batch_size,
-                "maxDelayMs": self.max_delay_s * 1e3,
-                "maxQueue": self.max_queue,
-                "buckets": list(self.buckets),
-                "queueDepth": len(self._q),
-                "batches": self._batches,
-                "queries": self._queries,
-                "rejected": self._rejected,
-                "batchSizeHist": {str(k): v for k, v in
-                                  sorted(self._size_hist.items())},
-                "bucketHist": {str(k): v for k, v in
-                               sorted(self._bucket_hist.items())},
-                "avgQueueWaitMs": (self._queue_wait_s / self._queries * 1e3
-                                   if self._queries else 0.0),
-                "avgFlushMs": (self._flush_s / self._batches * 1e3
-                               if self._batches else 0.0),
-            }
+            depth = len(self._q)
+            size_hist = {k: int(c.value)
+                         for k, c in self._size_children.items()}
+            bucket_hist = {k: int(c.value)
+                           for k, c in self._bucket_children.items()}
+        batches = int(self._m_batches.value)
+        queries = int(self._m_queries.value)
+        flush_s = self._m_flush.sum
+        return {
+            "maxBatchSize": self.max_batch_size,
+            "maxDelayMs": self.max_delay_s * 1e3,
+            "maxQueue": self.max_queue,
+            "buckets": list(self.buckets),
+            "queueDepth": depth,
+            "batches": batches,
+            "queries": queries,
+            "rejected": int(self._m_rejected.value),
+            "batchSizeHist": {str(k): v for k, v in
+                              sorted(size_hist.items())},
+            "bucketHist": {str(k): v for k, v in
+                           sorted(bucket_hist.items())},
+            "avgQueueWaitMs": (self._m_queue_wait.value / queries * 1e3
+                               if queries else 0.0),
+            "avgFlushMs": (flush_s / batches * 1e3 if batches else 0.0),
+        }

@@ -96,6 +96,16 @@ def _make_context(batch: str = "", devices: int = 0,
         mesh=mesh)
 
 
+def _apply_telemetry_env(args) -> None:
+    """Map the observability flags onto their env knobs (the library
+    layers read PIO_TELEMETRY / PIO_TRACE so in-process callers and
+    daemons honor the same switches; common/telemetry.py)."""
+    if getattr(args, "telemetry", False):
+        os.environ["PIO_TELEMETRY"] = "1"
+    if getattr(args, "trace", False):
+        os.environ["PIO_TRACE"] = "1"
+
+
 def _apply_read_env(args) -> None:
     """Map the train read-pipeline flags onto their env knobs (the storage
     layer reads PIO_READ_THREADS / PIO_READ_OVERLAP so library callers and
@@ -110,6 +120,7 @@ def _apply_read_env(args) -> None:
 
 def cmd_train(args) -> int:
     _apply_read_env(args)
+    _apply_telemetry_env(args)
     if getattr(args, "no_auto_resume", False):
         # disable the crashed-run checkpoint scan (workflow/core_workflow)
         os.environ["PIO_AUTO_RESUME"] = "0"
@@ -178,6 +189,7 @@ def cmd_deploy(args) -> int:
         QueryAPI, ServerConfig, serve, undeploy,
     )
     from predictionio_tpu.workflow.workflow_utils import read_engine_variant
+    _apply_telemetry_env(args)
     variant = read_engine_variant(os.path.abspath(args.engine_dir),
                                   args.variant)
     config = ServerConfig(
@@ -231,6 +243,7 @@ def cmd_run(args) -> int:
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api import EventAPI, EventServerConfig
     from predictionio_tpu.data.api.http import serve_forever
+    _apply_telemetry_env(args)
     api = EventAPI(config=EventServerConfig(
         ip=args.ip, port=args.port, stats=args.stats))
     _info(f"Event Server is started at {args.ip}:{args.port}.")
@@ -265,6 +278,7 @@ def cmd_storageserver(args) -> int:
     from predictionio_tpu.data.api.http import serve_forever
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.data.storage.remote import StorageRPCAPI
+    _apply_telemetry_env(args)
     key = args.key or os.environ.get("PIO_STORAGE_SERVER_KEY") or None
     storage = get_storage()
 
@@ -478,6 +492,16 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--variant", default="engine.json",
                         help="engine variant JSON (default: engine.json)")
 
+    def telemetry_flags(sp):
+        sp.add_argument("--telemetry", action="store_true",
+                        help="record hot-path metrics (sets "
+                             "PIO_TELEMETRY=1; GET /metrics serves "
+                             "Prometheus text either way)")
+        sp.add_argument("--trace", action="store_true",
+                        help="originate request traces (sets PIO_TRACE=1; "
+                             "propagated X-PIO-Trace headers are always "
+                             "honored); GET /traces.json")
+
     sp = sub.add_parser("build", help="validate an engine")
     engine_flags(sp)
 
@@ -512,6 +536,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="overlap chunk decode with vocab-encode and "
                          "host->HBM staging (default on; sets "
                          "PIO_READ_OVERLAP / PIO_READ_STAGE)")
+    telemetry_flags(sp)
 
     sp = sub.add_parser("eval", help="run an evaluation")
     sp.add_argument("evaluation_class")
@@ -541,6 +566,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--drain-grace-s", type=float, default=30.0,
                     help="SIGTERM graceful drain: seconds to wait for "
                          "in-flight batches before exiting")
+    telemetry_flags(sp)
 
     sp = sub.add_parser("undeploy", help="stop a deployed engine server")
     sp.add_argument("--ip", default="localhost")
@@ -555,6 +581,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="0.0.0.0")
     sp.add_argument("--port", type=int, default=7070)
     sp.add_argument("--stats", action="store_true")
+    telemetry_flags(sp)
 
     sp = sub.add_parser("dashboard", help="start the evaluation dashboard")
     sp.add_argument("--ip", default="127.0.0.1")
@@ -578,6 +605,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--key", default="",
                     help="shared secret clients must send "
                          "(X-PIO-Storage-Key)")
+    telemetry_flags(sp)
 
     sp = sub.add_parser("app", help="manage apps")
     asub = sp.add_subparsers(dest="app_command", required=True)

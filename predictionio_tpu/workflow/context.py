@@ -54,13 +54,39 @@ class WorkflowContext:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        """Accumulate one named phase's wall-clock.
+
+        Timing honesty (KNOWN_ISSUES #3): every phase body ends in a real
+        host transfer (a one-element jax.device_get) before this clock
+        stops — never block_until_ready, which can return early on
+        tunneled platforms. The same number is mirrored into the metrics
+        registry (`pio_train_phase_seconds{phase=...}`) when telemetry
+        is on, so `GET /metrics` and the EngineInstance phase table agree.
+        """
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.phase_seconds[name] = (
-                self.phase_seconds.get(name, 0.0)
-                + time.perf_counter() - t0)
+            self.note_phase(name, time.perf_counter() - t0)
+
+    def note_phase(self, name: str, seconds: float) -> None:
+        """Accumulate an externally-timed (sub-)phase — e.g. the bulk
+        read's read_io/read_encode split, measured inside the store —
+        into the phase table AND the metrics registry, identically to a
+        `with ctx.phase(name)` region."""
+        from predictionio_tpu.common import telemetry
+        self.phase_seconds[name] = (
+            self.phase_seconds.get(name, 0.0) + seconds)
+        if telemetry.on():
+            telemetry.registry().histogram(
+                "pio_train_phase_seconds",
+                "Train/eval phase wall-clock (read/layout/train/persist "
+                "+ read_io/read_encode sub-phases; regions end in a host "
+                "transfer per KNOWN_ISSUES #3)",
+                labelnames=("phase",),
+                buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
+                         30.0, 60.0, 300.0)).labels(
+                phase=name).observe(seconds)
 
     @property
     def storage(self) -> Storage:

@@ -153,6 +153,24 @@ def run_train(
             storage.get_model_data_models().insert(
                 Model(id=instance_id, models=blob))
         phases = dict(ctx.phase_seconds)
+        if profile_dir:
+            # the telemetry phase table lands NEXT TO the XLA profile so
+            # `pio train --profile DIR` yields both views of the same run:
+            # xprof/tensorboard for device time, this JSON for the
+            # host-side phase split (each phase ends in a real host
+            # transfer — KNOWN_ISSUES #3 — so the two can be reconciled)
+            import json as _pj
+            try:
+                os.makedirs(profile_dir, exist_ok=True)
+                with open(os.path.join(profile_dir,
+                                       "telemetry_phases.json"), "w") as f:
+                    _pj.dump({"engineInstanceId": instance_id,
+                              "phaseSeconds": {k: round(v, 6)
+                                               for k, v in phases.items()}},
+                             f, indent=2, sort_keys=True)
+            except OSError:
+                logger.warning("could not write telemetry phase table to "
+                               "%s", profile_dir, exc_info=True)
         logger.info("Training completed; EngineInstance %s COMPLETED "
                     "(model blob %d bytes)", instance_id, len(blob))
         row = instances.get(instance_id)

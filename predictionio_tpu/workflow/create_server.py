@@ -19,6 +19,7 @@ north star).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import logging
 import random
@@ -28,7 +29,7 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
-from predictionio_tpu.common import resilience
+from predictionio_tpu.common import resilience, telemetry, tracing
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
 from predictionio_tpu.data.event import (
@@ -46,6 +47,10 @@ logger = logging.getLogger("predictionio_tpu.server")
 #: (data/api/http.py) forwards the optional third element as response
 #: headers (Retry-After on 503 saturation).
 Response = Tuple[int, Any]
+
+#: distinguishes concurrently-live QueryAPI instances in the process
+#: metrics registry (tests, blue/green deploys in one process)
+_query_api_seq = itertools.count()
 
 
 @dataclasses.dataclass
@@ -178,9 +183,34 @@ class QueryAPI:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
-        self.degraded_count = 0
         self.start_time = utcnow()
+        # degraded accounting is registry-backed (single source of truth
+        # for GET / and GET /metrics), per-instance labeled so a fresh
+        # server starts at zero. TWO metrics because the batched serving
+        # path's degraded flag is BATCH-granular (KNOWN_ISSUES #6): a
+        # failed side-channel lookup taints every response of its flush,
+        # so the per-query count is an UPPER BOUND on affected queries —
+        # pio_degraded_batches_total counts actual tainted flushes.
+        inst = {"server": f"query#{next(_query_api_seq)}"}
+        reg = telemetry.registry()
+        self._m_degraded_queries = reg.counter(
+            "pio_degraded_queries_upper_bound",
+            "Responses flagged degraded; batch-granular taint makes this "
+            "an UPPER BOUND on truly affected queries (KNOWN_ISSUES #6)",
+            labelnames=("server",)).labels(**inst)
+        self._m_degraded_batches = reg.counter(
+            "pio_degraded_batches_total",
+            "Batched flushes tainted by a failed side-channel lookup "
+            "(each taints up to batch_max_size responses)",
+            labelnames=("server",)).labels(**inst)
         self._load()
+
+    @property
+    def degraded_count(self) -> int:
+        """Legacy per-query degraded counter (the `GET /` degradedCount
+        field), now read from the registry. Batch-granular: an upper
+        bound on affected queries when batching is on."""
+        return int(self._m_degraded_queries.value)
 
     # ------------------------------------------------------------- loading
     def _load(self) -> None:
@@ -244,11 +274,18 @@ class QueryAPI:
             # is not visible from here; KNOWN_ISSUES documents this)
             resilience.reset_degraded()
             supplemented = [serving.supplement(q) for q in queries]
-            per_algo = [protocol.predict_batch(a, m, supplemented)
-                        for a, m in zip(algorithms, models)]
+            # the batched device dispatch (ends in a real host transfer —
+            # jax.device_get of the top-k — per KNOWN_ISSUES #3, so the
+            # span duration is honest on tunneled platforms)
+            with tracing.span("dispatch", service="query-server"):
+                per_algo = [protocol.predict_batch(a, m, supplemented)
+                            for a, m in zip(algorithms, models)]
             served = [serving.serve(q, [col[j] for col in per_algo])
                       for j, q in enumerate(queries)]
             degraded = bool(resilience.pop_degraded())
+            if degraded:
+                # ONE tainted flush, up to len(queries) flagged responses
+                self._m_degraded_batches.inc()
             return [(p, degraded) for p in served]
 
         return MicroBatcher(
@@ -314,6 +351,9 @@ class QueryAPI:
                 return 200, {"status": "ok"}
             if path == "/readyz" and method == "GET":
                 return self._readyz()
+            t = telemetry.handle_route(method, path)
+            if t is not None:    # GET /metrics (Prometheus) / /traces.json
+                return t
             if path == "/queries.json" and method == "POST":
                 return self._queries(body)
             if path == "/reload" and method == "POST":
@@ -438,8 +478,13 @@ class QueryAPI:
             degraded = bool(resilience.pop_degraded())
         result = json_extractor.to_json_obj(prediction)
         if degraded:
-            with self._lock:
-                self.degraded_count += 1
+            # per-RESPONSE count: with batching on this over-counts (the
+            # whole flush is tainted), hence "upper bound" in the metric
+            # name and the KNOWN_ISSUES #6 caveat on degradedCount
+            self._m_degraded_queries.inc()
+            if batcher is None:
+                # inline path: a degraded query IS a degraded "batch" of 1
+                self._m_degraded_batches.inc()
             if isinstance(result, dict):
                 result = {**result, "degraded": True}
 
@@ -466,6 +511,16 @@ class QueryAPI:
                          "or /reload a healthy instance"}
 
         dt = time.perf_counter() - t0
+        if telemetry.on():
+            # end-to-end serve latency (parse -> batched/inline predict ->
+            # serialize); the predict path ends in a host transfer, so
+            # this histogram is honest on tunneled devices (issue #3)
+            telemetry.registry().histogram(
+                "pio_serve_seconds",
+                "POST /queries.json end-to-end serve latency",
+                labelnames=("mode",)).labels(
+                    mode="batched" if batcher is not None else "inline"
+            ).observe(dt)
         with self._lock:  # ThreadingHTTPServer: concurrent queries
             self.last_serving_sec = dt
             self.avg_serving_sec = (
