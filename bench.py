@@ -776,6 +776,48 @@ def measure_telemetry(storage, engine, n_conns: int = 8,
     }
 
 
+def measure_recompile_watch(storage, engine, warmup_queries: int = 24,
+                            steady_queries: int = 48):
+    """Recompile-watchdog leg (common/devicewatch.py): deploy the engine
+    with batching on and telemetry forced on, run a warmup burst, arm
+    the steady-state detector, then run the standard bucketed burst.
+    With the padding buckets holding, the post-warmup serving path must
+    compile NOTHING — `serve_post_warmup_recompiles` lands in the JSON
+    and BENCH_STRICT_EXTRAS=1 hard-fails when it is nonzero (the silent
+    p99 cliff the buckets exist to prevent)."""
+    from predictionio_tpu.common import devicewatch, telemetry
+    from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+    devicewatch.install()
+    devicewatch.reset_watchdog()
+    telemetry.set_enabled(True)
+    api = None
+    try:
+        api = QueryAPI(storage=storage, engine=engine,
+                       config=ServerConfig(batching="on"))
+
+        def burst(n):
+            for q in range(n):
+                st, _ = api.handle(
+                    "POST", "/queries.json",
+                    body=json.dumps({"user": f"u{q * 37 % 1000}",
+                                     "num": 10}).encode())
+                assert st == 200
+        burst(warmup_queries)
+        devicewatch.mark_serving_warmup_done()
+        before = devicewatch.post_warmup_recompiles()
+        burst(steady_queries)
+        recompiles = devicewatch.post_warmup_recompiles() - before
+        return {
+            "serve_post_warmup_recompiles": int(recompiles),
+            "xla_compiles_total": int(devicewatch.compiles_total()),
+        }
+    finally:
+        telemetry.set_enabled(None)
+        if api is not None:
+            api.close()
+
+
 def serve_and_measure(storage, engine, n_queries: int = 200):
     """Deploy via QueryAPI + HTTP and time front-door query round-trips."""
     import http.client
@@ -1007,6 +1049,17 @@ def main() -> None:
                 telem = {"telemetry_error": f"{type(e).__name__}: {e}",
                          "telemetry_scrape_ok": False}
 
+        # recompile-watchdog leg (common/devicewatch.py): after a warmup
+        # burst the standard bucketed serving path must compile NOTHING —
+        # a nonzero count is the padding-bucket p99 cliff, strict-fatal
+        recompile_watch = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                recompile_watch = measure_recompile_watch(storage, engine)
+            except Exception as e:
+                recompile_watch = {
+                    "recompile_watch_error": f"{type(e).__name__}: {e}"}
+
         # parity leg AFTER the timed passes: it reuses the already-compiled
         # hybrid program and adds only the csrb one, so warmup_compile_s
         # above stays an honest per-process compile measurement
@@ -1059,7 +1112,8 @@ def main() -> None:
         base = published.get("als_train_ml20m_s")
         vs = (base / steady_s) if base else None
 
-        print(json.dumps({
+        cache_after = cache_stats()
+        result = {
             "metric": "als_ml20m_train_steady10_s",
             "value": round(steady_s, 3),
             "unit": "s",
@@ -1093,9 +1147,23 @@ def main() -> None:
                 # persistent cache does not apply, so this is paid per
                 # process and is NOT part of any steady-state claim
                 "warmup_compile_s": round(warm_s, 3),
+                # first-class warmup-compile record: the cache delta
+                # distinguishes a cold-cache round (entries_before == 0,
+                # legitimately slow — ~399 s in BENCH_r05) from a true
+                # compile regression; benchtrend only compares rounds
+                # whose caches were both warm
+                "warmup_compile": {
+                    "seconds": round(warm_s, 3),
+                    "cold_cache": cache_before["entries"] == 0,
+                    "cache_entries_before": cache_before["entries"],
+                    "cache_entries_delta": (cache_after["entries"]
+                                            - cache_before["entries"]),
+                    "cache_bytes_delta": (cache_after["bytes"]
+                                          - cache_before["bytes"]),
+                },
                 "compile_cache": {"dir": cache_dir,
                                   "before": cache_before,
-                                  "after": cache_stats()},
+                                  "after": cache_after},
                 "kernel_knobs": {
                     k: os.environ.get(k, d) for k, d in (
                         ("PIO_ALS_KERNEL", "hybrid"),
@@ -1111,12 +1179,35 @@ def main() -> None:
                 "serve_http_p99_ms": round(p99_ms, 3),
                 **(throughput or {}),
                 **(telem or {}),
+                **(recompile_watch or {}),
                 **(eval_grid or {}),
                 **(ecom or {}),
                 **(robust or {}),
                 "device": str(jax.devices()[0]).split(":")[0],
             },
-        }))
+        }
+
+        # bench-trajectory gate (tools/benchtrend.py): compare this run
+        # against the historical BENCH_r*.json series; the per-metric
+        # deltas land in the artifact, the hard failures are strict-only
+        import glob as _glob
+
+        from predictionio_tpu.tools import benchtrend
+        trend_failures = []
+        history = sorted(_glob.glob(os.path.join(HERE, "BENCH_r*.json")))
+        if history:
+            try:
+                trend_failures, trend = benchtrend.gate_current(
+                    result, history,
+                    threshold=float(os.environ.get(
+                        "BENCH_TREND_THRESHOLD",
+                        benchtrend.DEFAULT_THRESHOLD)))
+                result["detail"]["trend"] = trend
+            except Exception as e:   # the trend must never sink the run
+                result["detail"]["trend"] = {
+                    "trend_error": f"{type(e).__name__}: {e}"}
+
+        print(json.dumps(result))
 
         # hard gates (round-4 Weak #2a: the bench PRINTED [NaN,NaN,NaN,NaN]
         # checksums and the round still shipped an 87.8 ms/iter headline
@@ -1171,6 +1262,23 @@ def main() -> None:
                     "metrics-off "
                     f"({telem['telemetry_off']['p99_ms']} ms) by >5% "
                     "with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and \
+                recompile_watch is not None:
+            if recompile_watch.get("recompile_watch_error"):
+                failures.append(
+                    "recompile-watchdog leg crashed "
+                    f"({recompile_watch['recompile_watch_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            elif recompile_watch.get("serve_post_warmup_recompiles", 0):
+                failures.append(
+                    f"{recompile_watch['serve_post_warmup_recompiles']} "
+                    "post-warmup XLA recompiles on the serving path "
+                    "(padding buckets not holding) with "
+                    "BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and trend_failures:
+            failures.append(
+                "bench trajectory regression vs best prior round: "
+                + "; ".join(trend_failures))
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and (
                 eval_grid or {}).get("eval_error"):
             # by default a crashed eval leg records eval_error and the run

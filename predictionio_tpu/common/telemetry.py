@@ -33,7 +33,9 @@ Everything is dependency-free stdlib, safe to import from any layer.
 
 from __future__ import annotations
 
+import json
 import os
+import re
 import threading
 import weakref
 from typing import (
@@ -193,6 +195,37 @@ class Histogram:
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
+#: Prometheus data-model grammar (https://prometheus.io/docs/concepts/
+#: data_model/): a name that violates it silently breaks every scraper
+#: downstream, so registration — not scrape time — is where it fails.
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+#: reserved by the exposition format itself (histogram/summary internals)
+_RESERVED_LABELS = frozenset({"le", "quantile"})
+
+
+def validate_names(name: str, labelnames: Sequence[str]) -> None:
+    """Raise ValueError unless metric + label names are legal Prometheus
+    identifiers. Called at registration so a typo'd name fails the
+    import/construction that introduced it, not a 3am scrape."""
+    if not _METRIC_NAME_RE.match(name or ""):
+        raise ValueError(
+            f"invalid metric name {name!r}: must match "
+            "[a-zA-Z_:][a-zA-Z0-9_:]*")
+    for ln in labelnames:
+        if not _LABEL_NAME_RE.match(ln or ""):
+            raise ValueError(
+                f"metric {name}: invalid label name {ln!r}: must match "
+                "[a-zA-Z_][a-zA-Z0-9_]*")
+        if ln.startswith("__"):
+            raise ValueError(
+                f"metric {name}: label name {ln!r} is reserved "
+                "(double-underscore prefix)")
+        if ln in _RESERVED_LABELS:
+            raise ValueError(
+                f"metric {name}: label name {ln!r} is reserved by the "
+                "exposition format")
+
 
 class Family:
     """All children of one metric name, e.g. every labeled series of
@@ -284,6 +317,7 @@ class MetricsRegistry:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
+                validate_names(name, labelnames)
                 fam = Family(name, help_, kind, tuple(labelnames),
                              buckets=buckets)
                 self._families[name] = fam
@@ -309,13 +343,19 @@ class MetricsRegistry:
 
     def register_collector(self, fn: Callable[[], Iterable[str]]) -> None:
         """Register a scrape-time line producer. Bound methods are held
-        via weakref so a garbage-collected owner silently drops out."""
+        via weakref so a garbage-collected owner silently drops out.
+        Registering the same callable twice is a no-op (daemons that
+        share a process — tests, blue/green deploys — all call their
+        subsystem's install() and must not duplicate series)."""
         ref: Any
         if hasattr(fn, "__self__"):
             ref = weakref.WeakMethod(fn)
         else:
             ref = fn
         with self._lock:
+            for existing in self._collectors:
+                if existing == ref or existing is fn:
+                    return
             self._collectors.append(ref)
 
     # ----------------------------------------------------------- exposition
@@ -400,12 +440,24 @@ class RegistryDict:
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def handle_route(method: str, path: str):
-    """Serve ``GET /metrics`` / ``GET /traces.json`` for any daemon's
-    route handler; returns None when the request is not a telemetry
-    route (the handler continues with its own table). Unauthenticated by
-    design, like ``/healthz`` — the payload is operational counters, not
-    data."""
+#: /traces.json?limit= ceiling: a scraper typo (limit=1e9) must not ask
+#: snapshot() to group more traces than the ring can even hold
+_TRACES_LIMIT_DEFAULT = 64
+_TRACES_LIMIT_MAX = 1024
+
+
+def handle_route(method: str, path: str,
+                 query: Optional[Dict[str, str]] = None):
+    """Serve ``GET /metrics`` / ``GET /traces.json`` /
+    ``GET /debug/device.json`` for any daemon's route handler; returns
+    None when the request is not a telemetry route (the handler
+    continues with its own table). Unauthenticated by design, like
+    ``/healthz`` — the payload is operational counters, not data.
+
+    /traces.json accepts ``?limit=N`` (bounds-checked: clamped to
+    [1, 1024], default 64) and ``?trace_id=<id>`` so `pio doctor` and
+    dashboards can do cheap targeted reads instead of dumping the whole
+    ring buffer."""
     if method != "GET":
         return None
     if path == "/metrics":
@@ -413,5 +465,25 @@ def handle_route(method: str, path: str):
             "Content-Type": EXPOSITION_CONTENT_TYPE}
     if path == "/traces.json":
         from predictionio_tpu.common import tracing
-        return 200, tracing.snapshot()
+        limit = _TRACES_LIMIT_DEFAULT
+        trace_id = None
+        if query:
+            raw = query.get("limit")
+            if raw is not None and raw != "":
+                try:
+                    limit = int(raw)
+                except ValueError:
+                    return 400, {"message":
+                                 f"limit must be an integer, got {raw!r}"}
+                limit = max(1, min(limit, _TRACES_LIMIT_MAX))
+            trace_id = query.get("trace_id") or None
+        return 200, tracing.snapshot(limit=limit, trace_id=trace_id)
+    if path == "/debug/device.json":
+        # human-readable device state (HBM, live arrays, compile cache,
+        # recompile watchdog) — pretty-printed for curl eyes; the same
+        # numbers ride /metrics for machines
+        from predictionio_tpu.common import devicewatch
+        return 200, json.dumps(devicewatch.debug_snapshot(), indent=2,
+                               sort_keys=True), {
+            "Content-Type": "application/json; charset=UTF-8"}
     return None

@@ -229,12 +229,23 @@ def record_span(name: str, ctx: Optional[TraceContext],
 # /traces.json
 # ---------------------------------------------------------------------------
 
-def snapshot(limit: int = 64) -> Dict[str, Any]:
-    """Ring-buffer contents grouped by trace, newest trace first."""
+def snapshot(limit: int = 64, trace_id: Optional[str] = None
+             ) -> Dict[str, Any]:
+    """Ring-buffer contents grouped by trace, newest trace first.
+
+    ``limit`` caps how many traces are grouped and serialized (the ring
+    itself stays bounded by PIO_TRACE_BUFFER); ``trace_id`` narrows the
+    result to one trace — the cheap targeted read `pio doctor` and
+    dashboards use instead of dumping the whole buffer. ``spanCount``
+    always reports the ring total so a filtered read still shows how
+    much is buffered."""
+    limit = max(1, int(limit))
     spans = _ring.spans()
     by_trace: Dict[str, List[Span]] = {}
     order: List[str] = []
     for s in spans:
+        if trace_id is not None and s.trace_id != trace_id:
+            continue
         if s.trace_id not in by_trace:
             by_trace[s.trace_id] = []
             order.append(s.trace_id)

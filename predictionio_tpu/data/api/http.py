@@ -18,7 +18,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
 
-from predictionio_tpu.common import resilience, telemetry, tracing
+from predictionio_tpu.common import devicewatch, resilience, telemetry, tracing
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -54,10 +54,18 @@ class _Handler(BaseHTTPRequestHandler):
         service = type(self.api).__name__
         t0 = time.perf_counter() if telemetry.on() else None
         try:
-            with tracing.activate(ctx):
-                with tracing.span(f"server:{parsed.path}", service=service):
-                    response = self.api.handle(
-                        method, parsed.path, query, body, headers)
+            # compile attribution lives in the transport (the Dapper
+            # platform-layer lesson): an XLA compile triggered on ANY
+            # daemon's request thread is attributed to its route without
+            # per-handler wiring. The serving hot paths narrow this
+            # further (batcher flush / inline predict regions).
+            with devicewatch.attribution(f"server:{parsed.path}",
+                                         phase="request"):
+                with tracing.activate(ctx):
+                    with tracing.span(f"server:{parsed.path}",
+                                      service=service):
+                        response = self.api.handle(
+                            method, parsed.path, query, body, headers)
             if len(response) == 3:
                 status, payload, extra_headers = response
             else:

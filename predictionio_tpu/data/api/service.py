@@ -96,6 +96,11 @@ class EventAPI:
         #: SIGTERM) so /readyz steers load balancers away while in-flight
         #: ingests and the final WAL flush complete
         self.draining = False
+        # device observability on this daemon's /metrics and
+        # /debug/device.json too (the event server rarely compiles, but
+        # the operator's scrape surface is uniform; idempotent)
+        from predictionio_tpu.common import devicewatch
+        devicewatch.install()
 
     # ------------------------------------------------------------------ auth
     def _authenticate(self, query: Dict[str, str],
@@ -156,8 +161,8 @@ class EventAPI:
         if path == "/healthz" and method == "GET":
             return 200, {"status": "ok"}
         from predictionio_tpu.common import telemetry
-        t = telemetry.handle_route(method, path)
-        if t is not None:       # GET /metrics (Prometheus) / /traces.json
+        t = telemetry.handle_route(method, path, query)
+        if t is not None:   # /metrics, /traces.json, /debug/device.json
             return t
         if path == "/readyz" and method == "GET":
             if self.draining:

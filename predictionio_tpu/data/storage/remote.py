@@ -118,6 +118,10 @@ class StorageRPCAPI:
         from collections import OrderedDict
         self._dedup_cache: "OrderedDict[str, Any]" = OrderedDict()
         self._dedup_lock = threading.Lock()
+        # uniform device-observability surface (/metrics gauges +
+        # /debug/device.json) on the storage daemon as well (idempotent)
+        from predictionio_tpu.common import devicewatch
+        devicewatch.install()
 
     # -- per-DAO method tables, each entry: args-dict -> JSON-able ----------
     def _events(self, m: str, a: Dict[str, Any]):
@@ -345,8 +349,8 @@ class StorageRPCAPI:
             return 200, {"status": "ok"}
         if method == "GET" and path == "/readyz":
             return self._readyz()
-        t = telemetry.handle_route(method, path)
-        if t is not None:       # GET /metrics (Prometheus) / /traces.json
+        t = telemetry.handle_route(method, path, query)
+        if t is not None:   # /metrics, /traces.json, /debug/device.json
             return t
         if self.key and not hmac.compare_digest(
                 headers.get("x-pio-storage-key", "").encode(

@@ -42,6 +42,7 @@ import numpy as np
 from jax import lax
 
 from predictionio_tpu import native
+from predictionio_tpu.common import devicewatch
 from predictionio_tpu.parallel.mesh import pad_to_multiple
 
 _EPS = 1e-8
@@ -844,14 +845,18 @@ def _run_csrb(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
         u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
 
     def run(u, v, n_iters):
-        return _train_csrb_jit(
-            u_oi, u_rat, u_pres, u_seg, bu.counts,
-            i_oi, i_rat, i_pres, i_seg, bi.counts,
-            u, v, iterations=n_iters, lambda_=float(lambda_),
-            alpha=float(alpha), n_users=data.n_users, n_items=data.n_items,
-            b=b, u_chunk=u_chunk, i_chunk=i_chunk,
-            reg_scaling=reg_scaling, implicit=implicit,
-            tuning=_tuning_key())
+        # compile attribution (common/devicewatch.py): a re-trace of the
+        # trainer shows up as pio_xla_compiles_total{fn="als_train_csrb"}
+        with devicewatch.attribution("als_train_csrb", phase="train"):
+            return _train_csrb_jit(
+                u_oi, u_rat, u_pres, u_seg, bu.counts,
+                i_oi, i_rat, i_pres, i_seg, bi.counts,
+                u, v, iterations=n_iters, lambda_=float(lambda_),
+                alpha=float(alpha), n_users=data.n_users,
+                n_items=data.n_items,
+                b=b, u_chunk=u_chunk, i_chunk=i_chunk,
+                reg_scaling=reg_scaling, implicit=implicit,
+                tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)
@@ -940,14 +945,15 @@ def _run_hybrid(data: ALSData, rank, iterations, lambda_, alpha, seed, chunk,
     bu, bi = data.by_user, data.by_item
 
     def run(u, v, n_iters):
-        return _train_hybrid_jit(
-            hy.D, hy.hot_ids, *hy.u_tail, *hy.i_tail,
-            bu.counts, bi.counts, u, v, iterations=n_iters,
-            lambda_=float(lambda_), alpha=float(alpha),
-            n_users=data.n_users, n_items=data.n_items, K=hy.K, b=b,
-            u_chunk=hy.u_chunk, i_chunk=hy.i_chunk,
-            reg_scaling=reg_scaling, implicit=implicit,
-            tuning=_tuning_key())
+        with devicewatch.attribution("als_train_hybrid", phase="train"):
+            return _train_hybrid_jit(
+                hy.D, hy.hot_ids, *hy.u_tail, *hy.i_tail,
+                bu.counts, bi.counts, u, v, iterations=n_iters,
+                lambda_=float(lambda_), alpha=float(alpha),
+                n_users=data.n_users, n_items=data.n_items, K=hy.K, b=b,
+                u_chunk=hy.u_chunk, i_chunk=hy.i_chunk,
+                reg_scaling=reg_scaling, implicit=implicit,
+                tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)
@@ -1069,13 +1075,14 @@ def train_explicit(
         u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
 
     def run(u, v, n_iters):
-        return _train_explicit_jit(
-            bu.self_idx, bu.other_idx, bu.rating, bu.counts,
-            bi.self_idx, bi.other_idx, bi.rating, bi.counts,
-            u, v, iterations=n_iters, lambda_=float(lambda_),
-            n_users=data.n_users, n_items=data.n_items,
-            chunk=chunk, reg_scaling=reg_scaling,
-            tuning=_tuning_key())
+        with devicewatch.attribution("als_train_scan", phase="train"):
+            return _train_explicit_jit(
+                bu.self_idx, bu.other_idx, bu.rating, bu.counts,
+                bi.self_idx, bi.other_idx, bi.rating, bi.counts,
+                u, v, iterations=n_iters, lambda_=float(lambda_),
+                n_users=data.n_users, n_items=data.n_items,
+                chunk=chunk, reg_scaling=reg_scaling,
+                tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)
@@ -1160,13 +1167,15 @@ def train_implicit(
         u0, v0 = _seed_factors(int(seed), data.n_users, data.n_items, rank)
 
     def run(u, v, n_iters):
-        return _train_implicit_jit(
-            bu.self_idx, bu.other_idx, bu.rating, bu.counts,
-            bi.self_idx, bi.other_idx, bi.rating, bi.counts,
-            u, v, iterations=n_iters, lambda_=float(lambda_),
-            alpha=float(alpha), n_users=data.n_users, n_items=data.n_items,
-            chunk=chunk, reg_scaling=reg_scaling,
-            tuning=_tuning_key())
+        with devicewatch.attribution("als_train_scan", phase="train"):
+            return _train_implicit_jit(
+                bu.self_idx, bu.other_idx, bu.rating, bu.counts,
+                bi.self_idx, bi.other_idx, bi.rating, bi.counts,
+                u, v, iterations=n_iters, lambda_=float(lambda_),
+                alpha=float(alpha), n_users=data.n_users,
+                n_items=data.n_items,
+                chunk=chunk, reg_scaling=reg_scaling,
+                tuning=_tuning_key())
 
     return _run_segmented(run, u0, v0, iterations, checkpoint_every,
                           checkpointer)

@@ -40,7 +40,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from predictionio_tpu.common import telemetry, tracing
+from predictionio_tpu.common import devicewatch, telemetry, tracing
 from predictionio_tpu.serving.protocol import bucket_for, pad_buckets
 
 #: distinguishes concurrently-live batchers (e.g. across /reload) in the
@@ -212,9 +212,19 @@ class MicroBatcher:
                                         now - p.t_enq, service=self.name)
             t0 = time.monotonic()
             try:
-                with tracing.activate(head_ctx):
-                    with tracing.span("flush", service=self.name):
-                        results = self._flush_fn([p.item for p in batch])
+                # recompile watchdog (common/devicewatch.py): any XLA
+                # compile inside the flush is attributed to the serving
+                # path; after warmup it is the padding-bucket alarm. The
+                # signature names the batch size that broke the bucket
+                # contract (the padded shape is the algorithm's concern,
+                # but the admitted size is what the operator can act on).
+                with devicewatch.serving_region(
+                        "serve_flush",
+                        signature=f"bucket={bucket},n={len(batch)}"):
+                    with tracing.activate(head_ctx):
+                        with tracing.span("flush", service=self.name):
+                            results = self._flush_fn(
+                                [p.item for p in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"flush returned {len(results)} results for a "
@@ -225,6 +235,7 @@ class MicroBatcher:
                 for p in batch:
                     p.error = e
             self._m_flush.observe(time.monotonic() - t0)
+            devicewatch.note_serving_flush()
             for p in batch:
                 p.done.set()
 

@@ -1,0 +1,336 @@
+"""Bench-trajectory tracker: the BENCH_r*.json series, read and gated.
+
+Five rounds of benchmarks exist as driver artifacts and nothing reads
+them: a perf regression only gets caught if a human happens to diff two
+JSON blobs. This module turns the series into (a) a per-metric trend
+table an operator can read in one glance and (b) a regression gate the
+bench wires in under ``BENCH_STRICT_EXTRAS=1`` — the newest run is
+compared per metric against the BEST prior run and hard-fails beyond a
+configurable threshold.
+
+    python -m predictionio_tpu.tools.benchtrend BENCH_r*.json
+    python -m predictionio_tpu.tools.benchtrend --gate --threshold 0.25 ...
+
+File formats accepted: the driver wrapper (``{"n", "cmd", "rc", "tail",
+"parsed": {...}}``) and the bare bench line (``{"metric", "value",
+"unit", "detail"}``). Unparseable files are reported and skipped — a
+corrupt round must not hide the trend of the others.
+
+Comparability rules (the part that keeps the gate honest):
+
+- The headline ``value`` only compares runs with the SAME ``metric``
+  name (r01-r03 measured wall-clock, r04+ measure slope steady-state —
+  numerically incomparable).
+- ``warmup_compile_s`` only compares runs that BOTH ran against a warm
+  persistent compile cache (``compile_cache.before.entries > 0``): a
+  cold-cache round legitimately pays the full remote compile (~400 s in
+  BENCH_r05) and must not read as a 14x regression against a warm one,
+  nor set an impossible baseline for cold rounds. Rounds without
+  compile-cache detail are treated as unknown and never compared.
+- A lower bound of one prior comparable value: the first round of a new
+  metric gates nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: (detail key | "value", direction, gated) — direction "down" = lower
+#: is better; gated metrics hard-fail the strict bench on regression.
+#: "warm-cache" is the warmup_compile_s special: gated, but only across
+#: warm-cache rounds (see module docstring).
+METRICS: Tuple[Tuple[str, str, Any], ...] = (
+    ("value", "down", True),
+    ("steady_per_iter_ms", "down", True),
+    ("cold_pio_train_total_s", "down", True),
+    ("warm_pio_train_total_s", "down", False),
+    ("serve_http_p50_ms", "down", True),
+    ("serve_http_p99_ms", "down", True),
+    ("ecom_unseen_p99_ms", "down", False),
+    ("event_store_write_s", "down", False),
+    ("phase_read_s", "down", False),
+    ("phase_layout_s", "down", False),
+    ("eval_grid_s", "down", False),
+    ("read_parallel_speedup", "up", False),
+    ("serve_batched_qps_gain", "up", True),
+    ("warmup_compile_s", "down", "warm-cache"),
+    ("serve_post_warmup_recompiles", "down", False),
+)
+
+#: regression tolerance vs the best prior run; generous on purpose —
+#: the r04->r05 history shows ~20% cross-round noise on serve p99
+#: (shared hosts, tunnel variance) that must not cry wolf
+DEFAULT_THRESHOLD = 0.25
+
+
+def _round_label(path: str) -> str:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return f"r{int(m.group(1)):02d}" if m else os.path.basename(path)
+
+
+def load_round(path: str) -> Optional[Dict[str, Any]]:
+    """One bench artifact -> {label, metric, value, detail} or None."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    body = raw.get("parsed") if isinstance(raw.get("parsed"), dict) else raw
+    if not isinstance(body, dict) or "metric" not in body:
+        return None
+    value = body.get("value")
+    if not isinstance(value, (int, float)):
+        return None
+    detail = body.get("detail")
+    return {
+        "label": _round_label(path),
+        "path": path,
+        "metric": str(body.get("metric")),
+        "value": float(value),
+        "detail": detail if isinstance(detail, dict) else {},
+    }
+
+
+def load_rounds(paths: Sequence[str]) -> Tuple[List[Dict[str, Any]],
+                                               List[str]]:
+    """(rounds sorted by label, skipped-path list)."""
+    rounds, skipped = [], []
+    for p in paths:
+        r = load_round(p)
+        if r is None:
+            skipped.append(p)
+        else:
+            rounds.append(r)
+    rounds.sort(key=lambda r: r["label"])
+    return rounds, skipped
+
+
+def metric_value(rnd: Dict[str, Any], key: str) -> Optional[float]:
+    v = rnd["value"] if key == "value" else rnd["detail"].get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _warm_cache(rnd: Dict[str, Any]) -> Optional[bool]:
+    """True/False when the round recorded compile-cache state, None when
+    unknown (pre-r05 rounds)."""
+    cc = rnd["detail"].get("compile_cache")
+    if not isinstance(cc, dict):
+        return None
+    before = cc.get("before")
+    if not isinstance(before, dict):
+        return None
+    return int(before.get("entries", 0) or 0) > 0
+
+
+def _comparable(key: str, gated: Any, a: Dict[str, Any],
+                b: Dict[str, Any]) -> bool:
+    if key == "value" and a["metric"] != b["metric"]:
+        return False
+    if gated == "warm-cache":
+        return _warm_cache(a) is True and _warm_cache(b) is True
+    return True
+
+
+def best_prior(rounds: Sequence[Dict[str, Any]], key: str,
+               direction: str, gated: Any,
+               last: Dict[str, Any]) -> Optional[float]:
+    vals = [metric_value(r, key) for r in rounds
+            if r is not last and _comparable(key, gated, r, last)]
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    return min(vals) if direction == "down" else max(vals)
+
+
+def regression_pct(last_v: float, best: float,
+                   direction: str) -> Optional[float]:
+    """Positive = worse than the best prior, as a fraction of it."""
+    if best == 0:
+        return None
+    if direction == "down":
+        return (last_v - best) / abs(best)
+    return (best - last_v) / abs(best)
+
+
+def gate(rounds: Sequence[Dict[str, Any]],
+         threshold: float = DEFAULT_THRESHOLD) -> List[str]:
+    """Regressions of the NEWEST round beyond threshold vs best prior."""
+    if len(rounds) < 2:
+        return []
+    last = rounds[-1]
+    failures = []
+    for key, direction, gated in METRICS:
+        if not gated:
+            continue
+        last_v = metric_value(last, key)
+        if last_v is None:
+            continue
+        best = best_prior(rounds, key, direction, gated, last)
+        if best is None:
+            continue
+        reg = regression_pct(last_v, best, direction)
+        if reg is not None and reg > threshold:
+            failures.append(
+                f"{key}: {last_v:g} is {reg * 100:.1f}% worse than the "
+                f"best prior run ({best:g}; threshold "
+                f"{threshold * 100:.0f}%)")
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v == int(v) and abs(v) < 1e9:
+        return str(int(v))
+    return f"{v:.3g}" if abs(v) >= 100 else f"{v:.4g}"
+
+
+def render(rounds: Sequence[Dict[str, Any]],
+           threshold: float = DEFAULT_THRESHOLD) -> str:
+    if not rounds:
+        return "benchtrend: no parseable bench rounds"
+    labels = [r["label"] for r in rounds]
+    last = rounds[-1]
+    rows: List[Tuple[str, List[str], str]] = []
+
+    # headline rows, one per distinct metric name in first-seen order
+    seen_names: List[str] = []
+    for r in rounds:
+        if r["metric"] not in seen_names:
+            seen_names.append(r["metric"])
+    for name in seen_names:
+        cells = [_fmt(r["value"]) if r["metric"] == name else "-"
+                 for r in rounds]
+        delta = ""
+        if last["metric"] == name:
+            best = best_prior(rounds, "value", "down", True, last)
+            reg = (regression_pct(last["value"], best, "down")
+                   if best is not None else None)
+            if reg is not None:
+                delta = f"{reg * +100:+.1f}% vs best"
+        rows.append((name, cells, delta))
+
+    for key, direction, gated in METRICS:
+        if key == "value":
+            continue
+        vals = [metric_value(r, key) for r in rounds]
+        if not any(v is not None for v in vals):
+            continue
+        best = best_prior(rounds, key, direction, gated, last)
+        last_v = metric_value(last, key)
+        delta = ""
+        if best is not None and last_v is not None:
+            reg = regression_pct(last_v, best, direction)
+            if reg is not None:
+                mark = " !" if (gated and reg > threshold) else ""
+                delta = f"{reg * 100:+.1f}% vs best{mark}"
+        elif gated == "warm-cache" and last_v is not None:
+            delta = "(cold/unknown cache — not compared)"
+        rows.append((key, [_fmt(v) for v in vals], delta))
+
+    name_w = max(len(n) for n, _c, _d in rows)
+    col_w = max(8, max((len(c) for _n, cells, _d in rows for c in cells),
+                       default=8))
+    head = ("metric".ljust(name_w) + "  "
+            + "  ".join(lb.rjust(col_w) for lb in labels) + "  trend")
+    lines = [head, "-" * len(head)]
+    for name, cells, delta in rows:
+        lines.append(name.ljust(name_w) + "  "
+                     + "  ".join(c.rjust(col_w) for c in cells)
+                     + ("  " + delta if delta else ""))
+    return "\n".join(lines)
+
+
+def trend_brief(rounds: Sequence[Dict[str, Any]],
+                threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
+    """Compact per-metric {best_prior, current, delta_pct} for embedding
+    in the bench JSON detail (the artifact should explain itself)."""
+    out: Dict[str, Any] = {}
+    if not rounds:
+        return out
+    last = rounds[-1]
+    for key, direction, gated in METRICS:
+        last_v = metric_value(last, key)
+        if last_v is None:
+            continue
+        best = best_prior(rounds, key, direction, gated, last)
+        if best is None:
+            continue
+        reg = regression_pct(last_v, best, direction)
+        out[key] = {"best_prior": best, "current": last_v,
+                    "delta_pct": (round(reg * 100, 2)
+                                  if reg is not None else None)}
+    return out
+
+
+def gate_current(current: Dict[str, Any], history_paths: Sequence[str],
+                 threshold: float = DEFAULT_THRESHOLD
+                 ) -> Tuple[List[str], Dict[str, Any]]:
+    """Gate an in-flight bench result (bench.py) against the historical
+    series; returns (failures, trend_brief). `current` is the bench's
+    own {"metric", "value", "detail"} dict."""
+    rounds, _skipped = load_rounds(history_paths)
+    cur = {
+        "label": "now", "path": "<current>",
+        "metric": str(current.get("metric", "")),
+        "value": float(current.get("value", 0.0)),
+        "detail": current.get("detail") or {},
+    }
+    rounds.append(cur)
+    return gate(rounds, threshold), trend_brief(rounds, threshold)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m predictionio_tpu.tools.benchtrend",
+        description="bench-trajectory trend table + regression gate")
+    p.add_argument("files", nargs="+",
+                   help="BENCH_r*.json artifacts (shell glob or literal)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit nonzero when the newest round regresses "
+                        "beyond --threshold vs the best prior run "
+                        "(also enabled by BENCH_STRICT_EXTRAS=1)")
+    p.add_argument("--threshold", type=float,
+                   default=float(os.environ.get("BENCH_TREND_THRESHOLD",
+                                                DEFAULT_THRESHOLD)),
+                   help=f"regression tolerance (default "
+                        f"{DEFAULT_THRESHOLD:g} = "
+                        f"{DEFAULT_THRESHOLD:.0%})")
+    args = p.parse_args(argv)
+
+    paths: List[str] = []
+    for pattern in args.files:
+        hit = sorted(_glob.glob(pattern))
+        paths.extend(hit if hit else [pattern])
+    rounds, skipped = load_rounds(paths)
+    for s in skipped:
+        print(f"benchtrend: skipping unparseable {s}", file=sys.stderr)
+    print(render(rounds, args.threshold))
+    if not rounds:
+        return 1
+    gating = args.gate or os.environ.get("BENCH_STRICT_EXTRAS") == "1"
+    if gating:
+        failures = gate(rounds, args.threshold)
+        if failures:
+            print("\nBENCHTREND GATE FAILED:\n  "
+                  + "\n  ".join(failures), file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -218,6 +218,17 @@ def cmd_deploy(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """One-screen operator verdict against a running daemon's
+    observability surface (tools/doctor.py): health, readiness, queue
+    depth, serve p99, circuit breakers, degraded batches, post-warmup
+    XLA recompiles, HBM headroom, trace buffer. Exit 0 green / 1 red /
+    2 unreachable."""
+    from predictionio_tpu.tools.doctor import run_doctor
+    url = args.url or f"http://{args.ip}:{args.port}"
+    return run_doctor(url, timeout=args.timeout)
+
+
 def cmd_undeploy(args) -> int:
     from predictionio_tpu.workflow.create_server import undeploy
     if undeploy(args.ip, args.port):
@@ -572,6 +583,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="localhost")
     sp.add_argument("--port", type=int, default=8000)
 
+    sp = sub.add_parser(
+        "doctor",
+        help="one-screen health verdict for a running daemon "
+             "(scrapes /healthz, /metrics, /traces.json, "
+             "/debug/device.json; exit 0 green / 1 red / 2 unreachable)")
+    sp.add_argument("url", nargs="?", default="",
+                    help="daemon base URL (default http://<ip>:<port>)")
+    sp.add_argument("--ip", default="localhost")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-scrape timeout in seconds")
+
     sp = sub.add_parser("run", help="run an arbitrary entry point")
     sp.add_argument("main_class")
     sp.add_argument("args", nargs="*")
@@ -676,6 +699,7 @@ _DISPATCH = {
     "eval": cmd_eval,
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
+    "doctor": cmd_doctor,
     "run": cmd_run,
     "eventserver": cmd_eventserver,
     "dashboard": cmd_dashboard,

@@ -1,0 +1,303 @@
+"""`pio doctor` — one-screen operator verdict for a running daemon.
+
+Scrapes a daemon's observability surface (`/healthz`, `/readyz`,
+`/metrics`, `/traces.json?limit=8`, `/debug/device.json`) and renders
+every check on one screen with a green/warn/red state:
+
+    $ pio doctor http://localhost:8000
+    pio doctor — http://localhost:8000 (QueryAPI)
+      health      ok    liveness probe answered
+      readiness   ok    ready
+      queue       ok    depth 0, 0 rejected (503) so far
+      serving     ok    p99 <= 2.5 ms over 1280 queries
+      breakers    ok    no circuit breaker open
+      degraded    ok    0 tainted batches
+      recompiles  ok    0 post-warmup XLA recompiles
+      hbm         --    no device memory stats (CPU / unsupported)
+      traces      ok    512 spans buffered
+    VERDICT: OK
+
+Exit code: 0 all green, 1 when any check is RED (open circuit breaker,
+post-warmup serving recompiles, failed health/readiness, HBM nearly
+exhausted), 2 when the daemon is unreachable. Warnings don't fail the
+exit code — they are the "look here next" tier.
+
+All reads are cheap and targeted: the trace read uses the `?limit=`
+filter instead of dumping the ring, and every scrape is a single GET.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+#: check states, in escalation order
+OK, WARN, RED, NA = "ok", "WARN", "RED", "--"
+
+#: HBM fill ratios for the headroom check
+_HBM_WARN = 0.80
+_HBM_RED = 0.95
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+
+
+def parse_metrics(text: str) -> Dict[str, List[Tuple[str, float]]]:
+    """Prometheus text exposition -> {name: [(labelstr, value), ...]}.
+    Lenient by design (a doctor must diagnose, not crash on, a daemon
+    whose exposition grew a series it doesn't know)."""
+    out: Dict[str, List[Tuple[str, float]]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels, value = m.groups()
+        try:
+            v = float(value.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        except ValueError:
+            continue
+        out.setdefault(name, []).append((labels or "", v))
+    return out
+
+
+def metric_sum(samples: Dict[str, List[Tuple[str, float]]],
+               name: str) -> Optional[float]:
+    if name not in samples:
+        return None
+    return sum(v for _labels, v in samples[name])
+
+
+def metric_max(samples: Dict[str, List[Tuple[str, float]]],
+               name: str) -> Optional[float]:
+    if name not in samples:
+        return None
+    return max(v for _labels, v in samples[name])
+
+
+def histogram_quantile(samples: Dict[str, List[Tuple[str, float]]],
+                       name: str, q: float) -> Optional[float]:
+    """Approximate quantile (bucket upper bound) of `<name>` aggregated
+    over every label set. Cumulative bucket counts sum safely across
+    label sets because each set is itself cumulative in `le`."""
+    buckets = samples.get(name + "_bucket")
+    if not buckets:
+        return None
+    agg: Dict[float, float] = {}
+    for labels, v in buckets:
+        m = re.search(r'le="([^"]+)"', labels)
+        if not m:
+            continue
+        le = float(m.group(1).replace("+Inf", "inf"))
+        agg[le] = agg.get(le, 0.0) + v
+    pts = sorted(agg.items())
+    if not pts or pts[-1][1] <= 0:
+        return None
+    target = q * pts[-1][1]
+    for le, cum in pts:
+        if cum >= target:
+            return le
+    return pts[-1][0]
+
+
+# ---------------------------------------------------------------------------
+# scraping
+# ---------------------------------------------------------------------------
+
+def _get(base_url: str, path: str, timeout: float):
+    """(status, body_text) or (None, error_string)."""
+    url = base_url.rstrip("/") + path
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, e.read().decode("utf-8", "replace")
+        except Exception:
+            return e.code, ""
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"
+
+
+def scrape(base_url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """Every surface the verdict reads, fetched once."""
+    out: Dict[str, Any] = {"url": base_url}
+    for key, path in (("healthz", "/healthz"), ("readyz", "/readyz"),
+                      ("metrics", "/metrics"),
+                      ("traces", "/traces.json?limit=8"),
+                      ("device", "/debug/device.json")):
+        status, body = _get(base_url, path, timeout)
+        out[key] = {"status": status, "body": body}
+    return out
+
+
+def _json_body(part: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    if part.get("status") is None:
+        return None
+    try:
+        obj = json.loads(part["body"])
+        return obj if isinstance(obj, dict) else None
+    except (ValueError, TypeError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# diagnosis
+# ---------------------------------------------------------------------------
+
+def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
+    """-> [(check, state, detail)], every section always present."""
+    checks: List[Tuple[str, str, str]] = []
+
+    # health -----------------------------------------------------------
+    hz = scraped["healthz"]
+    if hz["status"] is None:
+        checks.append(("health", RED, f"unreachable ({hz['body']})"))
+    elif hz["status"] == 200:
+        checks.append(("health", OK, "liveness probe answered"))
+    else:
+        checks.append(("health", RED, f"/healthz -> {hz['status']}"))
+
+    # readiness --------------------------------------------------------
+    rz = scraped["readyz"]
+    rz_body = _json_body(rz) or {}
+    if rz["status"] == 200:
+        checks.append(("readiness", OK,
+                       rz_body.get("status", "ready")))
+    elif rz["status"] in (404, None):
+        checks.append(("readiness", NA, "no /readyz on this daemon"))
+    else:
+        checks.append(("readiness", RED,
+                       f"/readyz -> {rz['status']} "
+                       f"({rz_body.get('status', '?')})"))
+
+    samples = parse_metrics(scraped["metrics"]["body"]
+                            if scraped["metrics"]["status"] == 200 else "")
+
+    # queue ------------------------------------------------------------
+    depth = metric_max(samples, "pio_batcher_queue_depth")
+    rejected = metric_sum(samples, "pio_batcher_rejected_total")
+    if depth is None and rejected is None:
+        checks.append(("queue", NA, "no batcher on this daemon"))
+    else:
+        state = WARN if (rejected or 0) > 0 else OK
+        checks.append(("queue", state,
+                       f"depth {int(depth or 0)}, "
+                       f"{int(rejected or 0)} rejected (503) so far"))
+
+    # serving latency --------------------------------------------------
+    p99 = histogram_quantile(samples, "pio_serve_seconds", 0.99)
+    count = metric_sum(samples, "pio_serve_seconds_count")
+    if p99 is None:
+        checks.append(("serving", NA,
+                       "no pio_serve_seconds yet (PIO_TELEMETRY off or "
+                       "no queries)"))
+    else:
+        ms = "inf" if p99 == float("inf") else f"{p99 * 1e3:g}"
+        checks.append(("serving", OK,
+                       f"p99 <= {ms} ms over {int(count or 0)} queries"))
+
+    # circuit breakers -------------------------------------------------
+    open_eps = [labels for labels, v in
+                samples.get("pio_breaker_open", []) if v >= 1]
+    if open_eps:
+        checks.append(("breakers", RED,
+                       f"{len(open_eps)} circuit breaker(s) OPEN: "
+                       + "; ".join(open_eps)))
+    elif "pio_breaker_open" in samples:
+        checks.append(("breakers", OK,
+                       f"{len(samples['pio_breaker_open'])} breaker(s), "
+                       "none open"))
+    else:
+        checks.append(("breakers", OK, "no circuit breaker open"))
+
+    # degraded serving -------------------------------------------------
+    tainted = metric_sum(samples, "pio_degraded_batches_total") or 0
+    checks.append(("degraded", WARN if tainted > 0 else OK,
+                   f"{int(tainted)} tainted batches (failed side-channel "
+                   "lookups)" if tainted else "0 tainted batches"))
+
+    # post-warmup recompiles (the devicewatch alarm) -------------------
+    recompiles = metric_sum(samples,
+                            "pio_xla_post_warmup_recompiles_total") or 0
+    device = _json_body(scraped["device"]) or {}
+    watchdog = device.get("watchdog") or {}
+    if recompiles > 0:
+        sigs = ", ".join(
+            f"{e.get('fn')}[{e.get('signature')}]"
+            for e in (watchdog.get("recentPostWarmup") or [])[-3:])
+        checks.append(("recompiles", RED,
+                       f"{int(recompiles)} post-warmup XLA recompiles on "
+                       f"the serving path{' — ' + sigs if sigs else ''} "
+                       "(padding-bucket regression?)"))
+    else:
+        armed = watchdog.get("servingWarmupDone")
+        note = "" if armed is None else (
+            " (watchdog armed)" if armed else " (still in warmup)")
+        checks.append(("recompiles", OK,
+                       f"0 post-warmup XLA recompiles{note}"))
+
+    # HBM headroom -----------------------------------------------------
+    in_use = metric_sum(samples, "pio_hbm_bytes_in_use")
+    limit = metric_sum(samples, "pio_hbm_bytes_limit")
+    if in_use is None or not limit:
+        checks.append(("hbm", NA,
+                       "no device memory stats (CPU / unsupported — "
+                       "KNOWN_ISSUES #8)"))
+    else:
+        frac = in_use / limit
+        state = RED if frac >= _HBM_RED else (
+            WARN if frac >= _HBM_WARN else OK)
+        checks.append(("hbm", state,
+                       f"{in_use / 2**30:.2f} / {limit / 2**30:.2f} GiB "
+                       f"in use ({frac * 100:.0f}%)"))
+
+    # traces -----------------------------------------------------------
+    tr = _json_body(scraped["traces"])
+    if tr is None:
+        checks.append(("traces", NA, "no /traces.json"))
+    else:
+        checks.append(("traces", OK,
+                       f"{tr.get('spanCount', 0)} spans buffered "
+                       f"(originate={'on' if tr.get('originate') else 'off'})"))
+    return checks
+
+
+def render(scraped: Dict[str, Any],
+           checks: List[Tuple[str, str, str]]) -> str:
+    service = ""
+    hz = _json_body(scraped.get("healthz", {}))
+    dv = _json_body(scraped.get("device", {})) or {}
+    if hz is not None and dv.get("telemetry") is False:
+        service = " (telemetry off — run the daemon with --telemetry " \
+                  "for device checks)"
+    lines = [f"pio doctor — {scraped['url']}{service}"]
+    width = max(len(c) for c, _s, _d in checks)
+    for check, state, detail in checks:
+        lines.append(f"  {check.ljust(width)}  {state:<4}  {detail}")
+    reds = sum(1 for _c, s, _d in checks if s == RED)
+    warns = sum(1 for _c, s, _d in checks if s == WARN)
+    if reds:
+        lines.append(f"VERDICT: RED ({reds} failing check(s)"
+                     + (f", {warns} warning(s)" if warns else "") + ")")
+    elif warns:
+        lines.append(f"VERDICT: OK with {warns} warning(s)")
+    else:
+        lines.append("VERDICT: OK")
+    return "\n".join(lines)
+
+
+def run_doctor(base_url: str, timeout: float = 5.0,
+               out=None) -> int:
+    """Scrape, diagnose, print; exit code 0 green / 1 red / 2 dead."""
+    scraped = scrape(base_url, timeout=timeout)
+    checks = diagnose(scraped)
+    text = render(scraped, checks)
+    print(text, file=out)
+    if scraped["healthz"]["status"] is None:
+        return 2
+    return 1 if any(s == RED for _c, s, _d in checks) else 0

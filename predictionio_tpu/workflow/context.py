@@ -62,10 +62,17 @@ class WorkflowContext:
         tunneled platforms. The same number is mirrored into the metrics
         registry (`pio_train_phase_seconds{phase=...}`) when telemetry
         is on, so `GET /metrics` and the EngineInstance phase table agree.
+
+        XLA compiles inside a phase are attributed to it
+        (`pio_xla_compiles_total{fn="train:<phase>",...}`, common/
+        devicewatch.py) unless a narrower region — the ALS trainers —
+        claims them first.
         """
+        from predictionio_tpu.common import devicewatch
         t0 = time.perf_counter()
         try:
-            yield
+            with devicewatch.attribution(f"train:{name}", phase="train"):
+                yield
         finally:
             self.note_phase(name, time.perf_counter() - t0)
 

@@ -85,11 +85,18 @@ def run_train(
     (the sharded trainer's collectives need all of them), but only
     process 0 writes the ledger row and model blob — the others train
     and return "" (the Spark-driver-vs-executor split, SURVEY.md §2.7).
+
+    Device observability: the devicewatch compile watchdog is installed
+    before training so `pio train --telemetry` attributes every XLA
+    compile to its phase/trainer (common/devicewatch.py).
     Iteration checkpointing is disabled UNIFORMLY on multi-host jobs:
     per-segment snapshots would give each rank a different compiled-call
     schedule (and resume a different restore state) unless the snapshot
     dir were a shared filesystem, which this runtime does not assume."""
     import jax
+
+    from predictionio_tpu.common import devicewatch
+    devicewatch.install()
     if jax.process_count() > 1:
         if resume_from:
             raise ValueError(

@@ -29,7 +29,7 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
-from predictionio_tpu.common import resilience, telemetry, tracing
+from predictionio_tpu.common import devicewatch, resilience, telemetry, tracing
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
 from predictionio_tpu.data.event import (
@@ -192,6 +192,9 @@ class QueryAPI:
         # so the per-query count is an UPPER BOUND on affected queries —
         # pio_degraded_batches_total counts actual tainted flushes.
         inst = {"server": f"query#{next(_query_api_seq)}"}
+        # device observability: compile watchdog + HBM/live-array gauges
+        # on this daemon's /metrics and /debug/device.json (idempotent)
+        devicewatch.install()
         reg = telemetry.registry()
         self._m_degraded_queries = reg.counter(
             "pio_degraded_queries_upper_bound",
@@ -351,8 +354,8 @@ class QueryAPI:
                 return 200, {"status": "ok"}
             if path == "/readyz" and method == "GET":
                 return self._readyz()
-            t = telemetry.handle_route(method, path)
-            if t is not None:    # GET /metrics (Prometheus) / /traces.json
+            t = telemetry.handle_route(method, path, query)
+            if t is not None:    # /metrics, /traces.json, /debug/device.json
                 return t
             if path == "/queries.json" and method == "POST":
                 return self._queries(body)
@@ -469,13 +472,18 @@ class QueryAPI:
             # batching off: the original single-query path, unchanged —
             # plus request-scoped degradation tracking (a failed storage
             # side-channel lookup serves from on-device factors and flags
-            # the response instead of 500ing)
+            # the response instead of 500ing). The devicewatch region
+            # makes an XLA compile inside this request attributable (and
+            # post-warmup, alarmed) exactly like the batched flush.
             resilience.reset_degraded()
-            supplemented = serving.supplement(query)
-            predictions = [a.predict(m, supplemented)
-                           for a, m in zip(algorithms, models)]
-            prediction = serving.serve(query, predictions)
+            with devicewatch.serving_region("serve_inline",
+                                            signature="inline"):
+                supplemented = serving.supplement(query)
+                predictions = [a.predict(m, supplemented)
+                               for a, m in zip(algorithms, models)]
+                prediction = serving.serve(query, predictions)
             degraded = bool(resilience.pop_degraded())
+            devicewatch.note_serving_flush()
         result = json_extractor.to_json_obj(prediction)
         if degraded:
             # per-RESPONSE count: with batching on this over-counts (the

@@ -1,0 +1,437 @@
+"""Device-observability tests (common/devicewatch.py + pio doctor).
+
+The acceptance surface of ISSUE 5: with PIO_TELEMETRY=1 the query
+server's /metrics exports pio_xla_compiles_total and compile-cache/HBM
+gauges; a deliberately shape-varying query burst (bypassing the padding
+buckets' protection) increments the post-warmup recompile counter while
+the standard bucketed burst keeps it at 0; /debug/device.json renders
+on all daemons; `pio doctor` exits 0 on a healthy server and nonzero on
+one with an open circuit breaker or post-warmup recompiles; and wire
+parity holds — with telemetry off the new surfaces are empty.
+"""
+
+import datetime as dt
+import io
+import json
+
+import pytest
+
+from predictionio_tpu.common import devicewatch, telemetry, tracing
+from predictionio_tpu.common.resilience import CircuitBreaker
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.data.api import EventAPI
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.storage.remote import StorageRPCAPI
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+)
+from predictionio_tpu.tools import doctor
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+
+def _clear_counter_family(name):
+    """Zero one counter family's children (the process registry is
+    additive by design; `pio doctor` reads absolutes, so its green-path
+    tests start the alarm counters from a clean slate). Safe for the
+    watchdog families: devicewatch looks children up per record instead
+    of caching them."""
+    reg = telemetry.registry()
+    with reg._lock:
+        fam = reg._families.get(name)
+    if fam is not None:
+        with fam._lock:
+            fam._children.clear()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Watchdog state and telemetry overrides never leak across tests
+    (registry families persist by design — assert on deltas)."""
+    telemetry.set_enabled(None)
+    tracing.set_enabled(None)
+    devicewatch.reset_watchdog()
+    CircuitBreaker.reset_registry()
+    yield
+    telemetry.set_enabled(None)
+    tracing.set_enabled(None)
+    devicewatch.reset_watchdog()
+    CircuitBreaker.reset_registry()
+
+
+def _train_engine(storage, n_items=9, rank=5):
+    """Train a small recommendation engine with an item count unique to
+    this module so its top-k programs are not already in the process jit
+    cache (other test files train 6-item rank-4 models)."""
+    app_id = storage.get_meta_data_apps().insert(App(0, "DevWatchApp"))
+    storage.get_events().init(app_id)
+    events = []
+    for u in range(10):
+        for i in range(n_items):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": 5.0 if (u % 3) == (i % 3) else 1.0}),
+                event_time=dt.datetime(2021, 1, 2, 0, (u + i) % 60,
+                                       tzinfo=dt.timezone.utc)))
+    storage.get_events().insert_batch(events, app_id)
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="DevWatchApp"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=rank, numIterations=2,
+                                       lambda_=0.05, seed=5)),))
+    run_train(WorkflowContext(storage=storage), engine, ep,
+              engine_factory="devicewatch-test",
+              params_json={
+                  "datasource": {"params": {"appName": "DevWatchApp"}},
+                  "algorithms": [{"name": "als", "params": {
+                      "rank": rank, "numIterations": 2, "lambda": 0.05,
+                      "seed": 5}}]})
+    return engine
+
+
+def _query(api, user, num):
+    st, body = api.handle("POST", "/queries.json", body=json.dumps(
+        {"user": user, "num": num}).encode())
+    assert st == 200, body
+    return body
+
+
+# ---------------------------------------------------------------------------
+# the watchdog core
+# ---------------------------------------------------------------------------
+
+def test_install_is_idempotent_and_hooks_monitoring():
+    assert devicewatch.install() is True    # jax.monitoring exists here
+    assert devicewatch.install() is True    # re-entrant
+
+
+def test_compile_events_attributed_to_regions():
+    import jax
+    import jax.numpy as jnp
+
+    devicewatch.install()
+    telemetry.set_enabled(True)
+    before = devicewatch.compiles_total()
+    with devicewatch.attribution("dw_test_fn", phase="train"):
+        jax.jit(lambda x: x + 41)(jnp.ones((17,)))
+    assert devicewatch.compiles_total() > before
+    fam = telemetry.registry().counter(
+        "pio_xla_compiles_total", labelnames=("fn", "phase"))
+    assert fam.labels(fn="dw_test_fn", phase="train").value >= 1
+    # compile durations observed (JAX's own host-side event)
+    hist = telemetry.registry().histogram("pio_xla_compile_seconds")
+    assert hist.labels().count >= 1
+
+
+def test_compile_events_not_recorded_with_telemetry_off():
+    import jax
+    import jax.numpy as jnp
+
+    devicewatch.install()
+    telemetry.set_enabled(False)
+    before = devicewatch.compiles_total()
+    with devicewatch.attribution("dw_off_fn"):
+        jax.jit(lambda x: x - 3)(jnp.ones((19,)))
+    assert devicewatch.compiles_total() == before
+
+
+def test_post_warmup_detector_via_jit_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    devicewatch.install()
+    telemetry.set_enabled(True)
+    f = jax.jit(lambda x: x * 2.5)
+    # warmup: compiles are expected and not alarmed
+    with devicewatch.serving_region("dw_serve", signature="a"):
+        f(jnp.ones((23,)))
+    base = devicewatch.post_warmup_recompiles()
+    devicewatch.mark_serving_warmup_done()
+    # steady state, same shape: no compile, no alarm
+    with devicewatch.serving_region("dw_serve", signature="a"):
+        f(jnp.ones((23,)))
+    assert devicewatch.post_warmup_recompiles() == base
+    # new shape post-warmup: the alarm fires and logs the signature
+    with devicewatch.serving_region("dw_serve", signature="b"):
+        f(jnp.ones((29,)))
+    assert devicewatch.post_warmup_recompiles() > base
+    snap = devicewatch.debug_snapshot()
+    recent = snap["watchdog"]["recentPostWarmup"]
+    assert recent and recent[-1]["fn"] == "dw_serve"
+    assert recent[-1]["signature"] == "b"
+
+
+def test_warmup_auto_arms_after_flush_count(monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_WARMUP_FLUSHES", "3")
+    devicewatch.reset_watchdog()
+    assert not devicewatch.serving_warmup_done()
+    for _ in range(3):
+        devicewatch.note_serving_flush()
+    assert devicewatch.serving_warmup_done()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the query server end to end
+# ---------------------------------------------------------------------------
+
+def test_query_server_recompile_watchdog_end_to_end(memory_storage,
+                                                    monkeypatch):
+    """The acceptance pair: a standard bucketed burst post-warmup keeps
+    the recompile counter at 0; a shape-varying burst (num=k is a static
+    arg of the batched top-k, so varying it bypasses the padding-bucket
+    protection exactly like a bucket regression would) increments it."""
+    # force device-resident serving so the batched path is jitted
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")
+    telemetry.set_enabled(True)
+    devicewatch.install()
+    engine = _train_engine(memory_storage)
+    api = QueryAPI(storage=memory_storage, engine=engine,
+                   config=ServerConfig(batching="on"))
+    try:
+        assert api._batcher is not None
+        # warmup: the standard burst at a fixed num compiles its program
+        for q in range(6):
+            _query(api, f"u{q}", 4)
+        devicewatch.mark_serving_warmup_done()
+        base = devicewatch.post_warmup_recompiles()
+        # standard bucketed burst: same shapes, zero recompiles
+        for q in range(8):
+            _query(api, f"u{q % 10}", 4)
+        assert devicewatch.post_warmup_recompiles() == base
+        # shape-varying burst: every new num is a new static k
+        for num in (5, 6, 7):
+            _query(api, "u1", num)
+        assert devicewatch.post_warmup_recompiles() > base
+        # /metrics on this daemon exports the counter + device gauges
+        _st, payload, _h = api.handle("GET", "/metrics")
+        assert "pio_xla_compiles_total" in payload
+        assert "pio_xla_post_warmup_recompiles_total" in payload
+        assert "pio_compile_cache_entries" in payload
+        assert "pio_live_arrays" in payload
+        # the flight recorder names the culprit
+        snap = devicewatch.debug_snapshot()
+        assert any(e["fn"] == "serve_flush"
+                   for e in snap["watchdog"]["recentPostWarmup"])
+    finally:
+        api.close()
+
+
+def test_hbm_gauges_gracefully_absent_on_cpu(memory_storage):
+    """CPU devices answer memory_stats() with None: the scrape must not
+    carry HBM series and /debug/device.json records the None outcome
+    (KNOWN_ISSUES #8)."""
+    telemetry.set_enabled(True)
+    devicewatch.install()
+    text = telemetry.registry().exposition()
+    assert "pio_hbm_bytes_in_use" not in text
+    snap = devicewatch.debug_snapshot()
+    assert snap["devices"], "jax is imported in tests; devices must list"
+    assert all(d["memoryStats"] is None for d in snap["devices"])
+
+
+def test_debug_device_route_on_all_three_daemons(memory_storage):
+    telemetry.set_enabled(True)
+    apis = [EventAPI(storage=memory_storage),
+            StorageRPCAPI(memory_storage, key="sekrit")]
+    for api in apis:
+        status, payload, headers = api.handle("GET", "/debug/device.json")
+        assert status == 200
+        assert headers["Content-Type"].startswith("application/json")
+        snap = json.loads(payload)
+        assert snap["telemetry"] is True
+        assert {"watchdog", "devices", "liveArrays",
+                "compileCache"} <= set(snap)
+
+
+def test_debug_device_route_empty_with_telemetry_off(memory_storage):
+    """Wire parity: until the operator opts in, the new endpoint says
+    only that the subsystem is dormant."""
+    telemetry.set_enabled(False)
+    api = EventAPI(storage=memory_storage)
+    status, payload, _h = api.handle("GET", "/debug/device.json")
+    assert status == 200
+    assert json.loads(payload) == {"telemetry": False}
+    # and the scrape carries no devicewatch gauges
+    text = telemetry.registry().exposition()
+    for name in ("pio_live_arrays", "pio_compile_cache_entries",
+                 "pio_hbm_bytes_in_use"):
+        assert name not in text
+
+
+# ---------------------------------------------------------------------------
+# /traces.json query filters (satellite)
+# ---------------------------------------------------------------------------
+
+def test_traces_limit_and_trace_id_filters(memory_storage):
+    tracing.clear()
+    contexts = []
+    for k in range(5):
+        ctx = tracing.new_context()
+        contexts.append(ctx)
+        with tracing.activate(ctx):
+            with tracing.span(f"op{k}", service="t"):
+                pass
+    api = EventAPI(storage=memory_storage)
+    # default: all five traces
+    st, snap = api.handle("GET", "/traces.json")
+    assert st == 200 and len(snap["traces"]) == 5
+    # ?limit=2 -> the two NEWEST traces; spanCount still reports the ring
+    st, snap = api.handle("GET", "/traces.json", {"limit": "2"})
+    assert st == 200 and len(snap["traces"]) == 2
+    assert snap["spanCount"] == 5
+    got = {t["traceId"] for t in snap["traces"]}
+    assert got == {contexts[-1].trace_id, contexts[-2].trace_id}
+    # ?trace_id= -> exactly that trace
+    st, snap = api.handle(
+        "GET", "/traces.json", {"trace_id": contexts[1].trace_id})
+    assert st == 200
+    assert [t["traceId"] for t in snap["traces"]] == [contexts[1].trace_id]
+    assert snap["traces"][0]["spans"][0]["name"] == "op1"
+    # bounds-checking: malformed limit is a 400, huge limit is clamped
+    st, err = api.handle("GET", "/traces.json", {"limit": "bogus"})
+    assert st == 400
+    st, snap = api.handle("GET", "/traces.json", {"limit": "999999999"})
+    assert st == 200 and len(snap["traces"]) == 5
+    tracing.clear()
+
+
+# ---------------------------------------------------------------------------
+# pio doctor (tier-1 smoke + red conditions)
+# ---------------------------------------------------------------------------
+
+def _doctor(url):
+    buf = io.StringIO()
+    code = doctor.run_doctor(url, timeout=10.0, out=buf)
+    return code, buf.getvalue()
+
+
+_SECTIONS = ("health", "readiness", "queue", "serving", "breakers",
+             "degraded", "recompiles", "hbm", "traces", "VERDICT")
+
+
+def test_doctor_green_against_live_query_server(memory_storage,
+                                                monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")
+    telemetry.set_enabled(True)
+    _clear_counter_family("pio_xla_post_warmup_recompiles_total")
+    _clear_counter_family("pio_batcher_rejected_total")
+    _clear_counter_family("pio_degraded_batches_total")
+    engine = _train_engine(memory_storage)
+    api = QueryAPI(storage=memory_storage, engine=engine,
+                   config=ServerConfig(batching="on"))
+    server, port = serve_background(api)
+    try:
+        for q in range(4):
+            _query(api, f"u{q}", 4)
+        code, text = _doctor(f"http://localhost:{port}")
+        assert code == 0, text
+        for section in _SECTIONS:
+            assert section in text, f"missing section {section}:\n{text}"
+        assert "VERDICT: OK" in text
+    finally:
+        server.shutdown()
+        api.close()
+
+
+def test_doctor_red_on_post_warmup_recompiles(memory_storage,
+                                              monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")
+    telemetry.set_enabled(True)
+    engine = _train_engine(memory_storage)
+    api = QueryAPI(storage=memory_storage, engine=engine,
+                   config=ServerConfig(batching="on"))
+    server, port = serve_background(api)
+    try:
+        _query(api, "u0", 4)
+        devicewatch.mark_serving_warmup_done()
+        # shape-varying: fires the alarm. ks distinct from every other
+        # test in this module — the process jit cache would otherwise
+        # serve the program without a compile event.
+        for num in (8, 3):
+            _query(api, "u0", num)
+        assert devicewatch.post_warmup_recompiles() >= 1
+        code, text = _doctor(f"http://localhost:{port}")
+        assert code == 1, text
+        assert "VERDICT: RED" in text
+        assert "recompile" in text
+    finally:
+        server.shutdown()
+        api.close()
+
+
+def test_doctor_red_on_open_circuit_breaker(memory_storage, monkeypatch):
+    monkeypatch.setenv("PIO_BREAKER_ENABLED", "1")
+    monkeypatch.setenv("PIO_BREAKER_MIN_CALLS", "2")
+    telemetry.set_enabled(True)
+    _clear_counter_family("pio_xla_post_warmup_recompiles_total")
+    br = CircuitBreaker.for_endpoint("dead-storage:7072")
+    for _ in range(4):
+        br.record(False)
+    assert br.state == CircuitBreaker.OPEN
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api)
+    try:
+        code, text = _doctor(f"http://localhost:{port}")
+        assert code == 1, text
+        assert "VERDICT: RED" in text
+        assert "dead-storage:7072" in text
+    finally:
+        server.shutdown()
+
+
+def test_doctor_unreachable_exits_2():
+    code, text = _doctor("http://127.0.0.1:1")    # nothing listens there
+    assert code == 2
+    assert "unreachable" in text
+
+
+def test_doctor_cli_wiring(memory_storage):
+    from predictionio_tpu.tools.cli import main as cli_main
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api)
+    try:
+        assert cli_main(["doctor", f"http://localhost:{port}"]) == 0
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# histogram-quantile helper (doctor's p99 math)
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_from_exposition():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("q_seconds", "q", buckets=(0.001, 0.01, 0.1)
+                      ).labels()
+    for _ in range(99):
+        h.observe(0.005)
+    h.observe(5.0)    # one outlier past every finite bucket
+    samples = doctor.parse_metrics(reg.exposition())
+    assert doctor.histogram_quantile(samples, "q_seconds", 0.5) == 0.01
+    assert doctor.histogram_quantile(
+        samples, "q_seconds", 0.999) == float("inf")
+
+
+def test_parse_metrics_tolerates_junk():
+    samples = doctor.parse_metrics(
+        "# HELP x y\nx_total 3\nx_total{a=\"b\"} 4\nnot a line\n")
+    assert doctor.metric_sum(samples, "x_total") == 7
+
+
+# ---------------------------------------------------------------------------
+# watchdog state isolation helper
+# ---------------------------------------------------------------------------
+
+def test_serving_region_restores_thread_state():
+    with devicewatch.attribution("outer", phase="train"):
+        with devicewatch.serving_region("inner", signature="s"):
+            pass
+        assert getattr(devicewatch._tls, "fn") == "outer"
+        assert getattr(devicewatch._tls, "phase") == "train"
+        assert getattr(devicewatch._tls, "serving") is False

@@ -118,6 +118,106 @@ def test_family_label_validation_and_kind_conflicts():
     assert fam.labels(k="a").value == 3
 
 
+def test_metric_name_validation_at_registration():
+    """Prometheus-grammar violations fail the registration that
+    introduced them, not a 3am scrape (ISSUE 5 satellite)."""
+    reg = MetricsRegistry()
+    for bad in ("0starts_with_digit", "has-dash", "has space", "", "x.y"):
+        with pytest.raises(ValueError, match="invalid metric name"):
+            reg.counter(bad)
+    for bad_label in ("0num", "has-dash", "", "x.y"):
+        with pytest.raises(ValueError, match="invalid label name"):
+            reg.gauge("ok_name", labelnames=(bad_label,))
+    with pytest.raises(ValueError, match="reserved"):
+        reg.histogram("ok_hist", labelnames=("le",))
+    with pytest.raises(ValueError, match="reserved"):
+        reg.counter("ok_counter", labelnames=("__meta",))
+    # colons are legal in metric names (recording-rule convention)
+    reg.counter("ns:sub_total", labelnames=("k",))
+
+
+def test_all_registered_names_validate_after_importing_everything():
+    """Import every instrumented module (and touch the instance-level
+    registrations) — every name/label the process registers must pass
+    the validator. Guards against drift in modules that build metric
+    names dynamically."""
+    import predictionio_tpu.common.devicewatch  # noqa: F401
+    import predictionio_tpu.common.resilience  # noqa: F401
+    import predictionio_tpu.common.tracing  # noqa: F401
+    import predictionio_tpu.data.api.stats  # noqa: F401
+    import predictionio_tpu.data.storage.eventlog  # noqa: F401
+    import predictionio_tpu.data.storage.remote  # noqa: F401
+    import predictionio_tpu.models.recommendation.als_algorithm  # noqa: F401
+    import predictionio_tpu.ops.staging  # noqa: F401
+    import predictionio_tpu.serving.batcher as batcher_mod
+    import predictionio_tpu.workflow.context  # noqa: F401
+    import predictionio_tpu.workflow.create_server  # noqa: F401
+
+    # instance-level registrations (batcher) on top of import-time ones
+    b = batcher_mod.MicroBatcher(lambda items: items, max_batch_size=2)
+    try:
+        reg = telemetry.registry()
+        with reg._lock:
+            families = list(reg._families.values())
+        assert families, "nothing registered?"
+        for fam in families:
+            telemetry.validate_names(fam.name, fam.labelnames)
+    finally:
+        b.close()
+
+
+def test_metrics_scrape_under_concurrent_mutation():
+    """A scraper looping against writer threads: every exposition must
+    parse, and per-series counter totals must be monotone (ISSUE 5
+    satellite — the scrape takes no registry-wide lock, so this is the
+    test that the per-child locking story actually holds)."""
+    reg = MetricsRegistry()
+    c = reg.counter("mut_total", "m", labelnames=("k",))
+    h = reg.histogram("mut_seconds", "m", buckets=(0.01, 0.1, 1.0)
+                      ).labels()
+    stop = threading.Event()
+    errors = []
+
+    def writer(label):
+        child = c.labels(k=label)
+        v = 0.001
+        while not stop.is_set():
+            child.inc()
+            h.observe(v)
+            v = (v * 7) % 1.7
+
+    threads = [threading.Thread(target=writer, args=(f"w{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        last_totals = {}
+        last_count = 0
+        for _ in range(50):
+            try:
+                types, samples = parse_prometheus(reg.exposition())
+            except AssertionError as e:
+                errors.append(f"unparseable exposition: {e}")
+                break
+            for labels, v in samples.get("mut_total", []):
+                prev = last_totals.get(labels, 0.0)
+                if v < prev:
+                    errors.append(
+                        f"counter went backwards: {labels} {prev}->{v}")
+                last_totals[labels] = v
+            for _labels, v in samples.get("mut_seconds_count", []):
+                if v < last_count:
+                    errors.append(
+                        f"histogram count went backwards: "
+                        f"{last_count}->{v}")
+                last_count = v
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+    assert not errors, errors[:5]
+
+
 def test_registry_dict_is_dictlike_and_registry_backed():
     reg = MetricsRegistry()
     fam = reg.counter("layout_total", "t", labelnames=("result",))

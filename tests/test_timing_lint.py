@@ -89,10 +89,12 @@ def test_no_wall_clock_time_in_package():
 #: block_until_ready here is the exact KNOWN_ISSUES #3 bug shape. (ops/
 #: kernels may legitimately use it for non-timing dispatch control.)
 _TIMED_MODULES = (
-    "common/telemetry.py", "common/tracing.py", "serving/batcher.py",
+    "common/telemetry.py", "common/tracing.py", "common/devicewatch.py",
+    "serving/batcher.py",
     "workflow/context.py", "workflow/core_workflow.py",
     "workflow/create_server.py", "data/store.py", "ops/staging.py",
     "models/recommendation/als_algorithm.py",
+    "tools/benchtrend.py", "tools/doctor.py",
 )
 
 
