@@ -162,11 +162,26 @@ def note_serving_flush() -> None:
 
 
 def mark_serving_warmup_done() -> None:
-    """Arm the steady-state detector now (deploy scripts / tests / the
-    bench call this after their deliberate warmup burst)."""
+    """Arm the steady-state detector now. The AOT deploy path
+    (serving/aot.py) calls this the moment its prebuild completes —
+    warmup end is an explicit AOT-complete mark, not a flush count —
+    and the bench/tests call it after a deliberate warmup burst."""
     global _warmup_done
     with _lock:
         _warmup_done = True
+
+
+#: most recent AOT prebuild summary (serving/aot.py via note_aot);
+#: /debug/device.json and `pio doctor` read it
+_aot_state: Optional[Dict[str, Any]] = None
+
+
+def note_aot(summary: Optional[Dict[str, Any]]) -> None:
+    """Record (or with None, clear) the deploy's AOT prebuild summary
+    for the debug surface."""
+    global _aot_state
+    with _lock:
+        _aot_state = dict(summary) if summary is not None else None
 
 
 def serving_warmup_done() -> bool:
@@ -452,6 +467,7 @@ def debug_snapshot() -> Dict[str, Any]:
             "servingSignatures": sorted(_serving_sigs),
             "recentPostWarmup": list(_post_warmup_events),
         }
+        aot_state = dict(_aot_state) if _aot_state is not None else None
     watchdog["compilesTotal"] = compiles_total()
     watchdog["postWarmupRecompiles"] = post_warmup_recompiles()
     with CircuitBreaker._registry_lock:
@@ -460,6 +476,7 @@ def debug_snapshot() -> Dict[str, Any]:
     return {
         "telemetry": True,
         "watchdog": watchdog,
+        "aot": aot_state,
         "devices": _device_stats(),
         "liveArrays": _live_array_stats(),
         "compileCache": {"dir": compile_cache_dir(),

@@ -146,6 +146,18 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
         override this (serving.protocol.batch_capable)."""
         return [self.predict(model, q) for q in queries]
 
+    def aot_serving_programs(self, model: M, buckets, declared=False):
+        """Declared-shape device programs for AOT prebuild
+        (serving/aot.py): return ProgramSpecs for every jitted program
+        this algorithm's serving path would compile lazily, one per
+        (padding bucket, k). Called at deploy time before /readyz flips
+        ready, and at train time (``declared=True`` — enumerate from
+        shapes even though the model is host-resident) to export the
+        programs' compile-cache entries with the model artifact.
+        Default: no device programs (host-serving algorithms deploy
+        instantly)."""
+        return ()
+
     # -- persistence hooks (BaseAlgorithm.makePersistentModel) --------------
     def make_persistent_model(self, ctx, instance_id: str, model: M) -> Any:
         """Return the object to persist for this model

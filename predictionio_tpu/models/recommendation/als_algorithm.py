@@ -309,6 +309,30 @@ class ALSAlgorithm(Algorithm):
             rank=model.rank, user_factors=U, item_factors=V,
             user_vocab=model.user_vocab, item_vocab=model.item_vocab)
 
+    def aot_serving_programs(self, model: ALSModel, buckets,
+                             declared: bool = False):
+        """Enumerate this model's device serving programs from declared
+        shapes (serving/aot.py): topk_for_users per (bucket, k) — the
+        micro-batcher's flush kernel — plus topk_for_user per k for the
+        batching-off inline path. When prepare_serving chose the host
+        path (numpy factors) there are no device programs to build and
+        deploy stays instant; ``declared=True`` (the `pio train` cache-
+        artifact export) enumerates regardless, since the eventual
+        deploy may well pick the device path on its own hardware."""
+        if not declared and isinstance(model.user_factors, np.ndarray):
+            return ()
+        from predictionio_tpu.serving import aot
+
+        n_users, rank = (int(d) for d in np.shape(model.user_factors))
+        n_items = int(np.shape(model.item_factors)[0])
+        ks = aot.serving_ks(n_items)
+        arrays = (None if declared
+                  else (model.user_factors, model.item_factors))
+        return (aot.specs_topk_for_users(n_users, n_items, rank,
+                                         buckets, ks, arrays=arrays)
+                + aot.specs_topk_for_user(n_users, n_items, rank, ks,
+                                          arrays=arrays))
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         user_ix = model.user_vocab.get(query.user)
         if user_ix is None:

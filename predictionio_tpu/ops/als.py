@@ -778,6 +778,48 @@ def bucket_units(n: int, step: float = 1.25) -> int:
     return b
 
 
+def declared_nnz_pad(nnz: int, chunk: int = 1 << 18) -> int:
+    """The COO pad :func:`prepare_ratings` would apply to ``nnz``
+    ratings — computable from the declared count alone, no data. This
+    makes :func:`bucket_units` the AOT shape oracle (serving/aot.py):
+    the trainer program for a declared event-log size can be lowered
+    and compiled before any ratings are read."""
+    return bucket_units(max(-(-nnz // chunk), 1)) * chunk
+
+
+def lower_train_explicit(n_users: int, n_items: int, rank: int, nnz: int,
+                         chunk: int = 1 << 18,
+                         reg_scaling: str = "count"):
+    """AOT-lower the scan-kernel explicit trainer from declared shapes.
+
+    Returns the jax Lowered for exactly the program
+    :func:`train_explicit`(kernel="scan") would trace for a layout of
+    ``nnz`` ratings: array shapes come from :func:`declared_nnz_pad`,
+    iteration count and lambda stay traced (concrete exemplars abstract
+    to the same weak-typed scalars), and the statics — including the
+    env-derived tuning key — match the lazy path's jit cache key, so
+    ``.compile()`` seeds the persistent cache entry the real train
+    would otherwise build. The hybrid/csrb kernels derive statics from
+    data skew and are NOT declarable; their programs ship via the
+    compile-cache artifact instead (workflow/model_io.py)."""
+    nnz_pad = declared_nnz_pad(nnz, chunk)
+    chunk_eff = min(chunk, nnz_pad)
+
+    def side(n_self: int):
+        return (jax.ShapeDtypeStruct((nnz_pad,), jnp.int32),
+                jax.ShapeDtypeStruct((nnz_pad,), jnp.int32),
+                jax.ShapeDtypeStruct((nnz_pad,), jnp.float32),
+                jax.ShapeDtypeStruct((n_self,), jnp.int32))
+
+    return _train_explicit_jit.lower(
+        *side(n_users), *side(n_items),
+        jax.ShapeDtypeStruct((n_users, rank), jnp.float32),
+        jax.ShapeDtypeStruct((n_items, rank), jnp.float32),
+        1, 0.01,
+        n_users=n_users, n_items=n_items, chunk=chunk_eff,
+        reg_scaling=reg_scaling, tuning=_tuning_key())
+
+
 def _csrb_plan(nnz: int, n_self: int, b: int, chunk: int) -> Tuple[int, int]:
     """(n_mb, chunk_eff): static mini-block count + scan chunk, shrunk for
     tiny inputs so tests don't pad 100 entries to a 2^18 slab."""

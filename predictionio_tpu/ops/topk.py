@@ -7,6 +7,14 @@ similarproduct/ecommerce templates with one fused matmul + mask + lax.top_k.
 
 Everything is jitted once per (n_items, rank, k) shape and reused across
 queries, so a deployed engine server answers from HBM with no recompile.
+
+AOT contract (serving/aot.py): every ``@jax.jit`` entry point in this
+module MUST be registered with the AOT enumerator (a tier-1 lint in
+tests/test_aot.py enforces it), so `pio deploy` can compile the full
+(padding bucket x template x k) program set from declared shapes before
+/readyz flips ready. Adding a jitted serving kernel here without
+registering it would silently reintroduce the first-dispatch warmup
+cliff — the lint makes that a test failure instead.
 """
 
 from __future__ import annotations

@@ -10,6 +10,10 @@ call (the dynamic-batching pattern from production inference servers):
 - :mod:`protocol` — the `predict_batch(model, queries)` algorithm
   protocol, padding-bucket selection, and the generic fall-back that
   maps per-query `predict` so every existing engine keeps working.
+- :mod:`aot` — ahead-of-time compilation of the (bucket × template ×
+  k) serving program set before `/readyz` flips ready, observed-bucket
+  pruning, and the persistent compile cache as a deploy artifact
+  (imported lazily — it pulls in the jitted kernels).
 """
 
 from predictionio_tpu.serving.batcher import (  # noqa: F401

@@ -23,6 +23,17 @@ its own label so a /reload's fresh batcher starts from zero exactly as
 the old per-instance counters did. Updates stay a handful of scalar
 bumps per BATCH, not per query.
 
+AOT interplay (serving/aot.py): the deploy hands this batcher its
+observation-pruned bucket set — the exact set whose programs were
+AOT-prebuilt before /readyz flipped ready — and each flush installs it
+thread-locally (``protocol.flush_buckets``) so predict_batch pads onto a
+bucket whose program is already warm, never the process defaults. The
+exact-flush-size counters this batcher records
+(``pio_batcher_batch_size``) are the observed histogram the next
+prebuild prunes against, and the recompile watchdog's warmup is marked
+done by the AOT prebuild itself (an explicit mark, not a flush count),
+making any serving-path compile after ready an alarm.
+
 Tracing (common/tracing.py): when a submitting request carries a trace
 context, the batch records an `admission` span per item (enqueue → batch
 formation) and a `flush` span around the flush callback, parented on the
@@ -41,6 +52,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.common import devicewatch, telemetry, tracing
+from predictionio_tpu.serving import protocol
 from predictionio_tpu.serving.protocol import bucket_for, pad_buckets
 
 #: distinguishes concurrently-live batchers (e.g. across /reload) in the
@@ -221,10 +233,14 @@ class MicroBatcher:
                 with devicewatch.serving_region(
                         "serve_flush",
                         signature=f"bucket={bucket},n={len(batch)}"):
-                    with tracing.activate(head_ctx):
-                        with tracing.span("flush", service=self.name):
-                            results = self._flush_fn(
-                                [p.item for p in batch])
+                    # flush-scoped bucket set: predict_batch on this
+                    # thread pads onto THIS batcher's (pruned, AOT-
+                    # prebuilt) buckets, not the process defaults
+                    with protocol.flush_buckets(self.buckets):
+                        with tracing.activate(head_ctx):
+                            with tracing.span("flush", service=self.name):
+                                results = self._flush_fn(
+                                    [p.item for p in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"flush returned {len(results)} results for a "

@@ -16,17 +16,52 @@ padding rows out, so at most len(buckets) programs exist per (k, shapes).
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
 #: default padding buckets; override per-process with PIO_SERVE_BUCKETS
 #: (comma-separated, e.g. "1,8,64").
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
 
+#: FLUSH-SCOPED bucket set (serving/aot.py): the micro-batcher installs
+#: its own — observation-pruned, AOT-prebuilt — bucket set on the
+#: worker thread around each flush, so an algorithm's predict_batch
+#: pads onto exactly the programs its deploy compiled. Thread-local
+#: and context-managed: concurrent servers with different pruned sets
+#: coexist, and nothing leaks past the flush (or the server) that
+#: installed it.
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def flush_buckets(buckets: Optional[Sequence[int]]):
+    """Scope the calling thread's bucket resolution to ``buckets`` (the
+    flushing batcher's set); None is a no-op passthrough."""
+    if buckets is None:
+        yield
+        return
+    prev = getattr(_tls, "buckets", None)
+    _tls.buckets = pad_buckets(buckets)
+    try:
+        yield
+    finally:
+        _tls.buckets = prev
+
+
+def active_buckets() -> Optional[Tuple[int, ...]]:
+    """The calling thread's flush-scoped bucket set, if inside one."""
+    return getattr(_tls, "buckets", None)
+
 
 def pad_buckets(buckets: Optional[Sequence[int]] = None) -> Tuple[int, ...]:
-    """Normalized, sorted bucket tuple (explicit arg > env > default)."""
+    """Normalized, sorted bucket tuple (explicit arg > flush-scoped set
+    > env > default)."""
     if buckets is None:
+        active = active_buckets()
+        if active is not None:
+            return active
         env = os.environ.get("PIO_SERVE_BUCKETS")
         if env:
             buckets = [int(tok) for tok in env.split(",") if tok.strip()]

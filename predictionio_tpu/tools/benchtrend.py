@@ -29,6 +29,13 @@ Comparability rules (the part that keeps the gate honest):
   compile-cache detail are treated as unknown and never compared.
 - A lower bound of one prior comparable value: the first round of a new
   metric gates nothing.
+- AOT era (serving/aot.py): ``warmup_compile_s`` stays train-compile-
+  only (the bench subtracts the aot_export phase), so pre- and post-AOT
+  rounds compare like with like; the serving-side cliff splits into
+  ``aot_prebuild_s`` (deploy-time, off the request path) and
+  ``first_query_compile_s`` (the lazy control). ``time_to_ready_s``
+  additionally carries an ABSOLUTE ceiling (< 10 s, warm-cache rounds
+  only) — the warm-replica availability contract, not a relative trend.
 """
 
 from __future__ import annotations
@@ -61,7 +68,22 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     ("serve_batched_qps_gain", "up", True),
     ("warmup_compile_s", "down", "warm-cache"),
     ("serve_post_warmup_recompiles", "down", False),
+    # AOT era (serving/aot.py): prebuild/first-query compile split so
+    # pre- and post-AOT rounds compare like with like, plus the
+    # warm-replica readiness record the absolute gate below enforces
+    ("time_to_ready_s", "down", False),
+    ("aot_prebuild_s", "down", False),
+    ("first_query_compile_s", "down", False),
 )
+
+#: absolute ceilings (metric -> limit), enforced on the NEWEST round
+#: regardless of history: some records are availability contracts, not
+#: relative trends. time_to_ready_s < 10 s is the warm-replica promise
+#: from ROADMAP Open item 2 — a deploy that pre-seeds its compile cache
+#: from the model's artifact must be servable in seconds.
+ABSOLUTE_GATES: Dict[str, float] = {
+    "time_to_ready_s": 10.0,
+}
 
 #: regression tolerance vs the best prior run; generous on purpose —
 #: the r04->r05 history shows ~20% cross-round noise on serve p99
@@ -160,11 +182,24 @@ def regression_pct(last_v: float, best: float,
 
 def gate(rounds: Sequence[Dict[str, Any]],
          threshold: float = DEFAULT_THRESHOLD) -> List[str]:
-    """Regressions of the NEWEST round beyond threshold vs best prior."""
-    if len(rounds) < 2:
+    """Regressions of the NEWEST round beyond threshold vs best prior,
+    plus the ABSOLUTE_GATES ceilings (which need no prior round — the
+    first AOT round is already accountable for the <10 s promise)."""
+    if not rounds:
         return []
     last = rounds[-1]
     failures = []
+    for key, limit in ABSOLUTE_GATES.items():
+        v = metric_value(last, key)
+        # warm-cache rounds only, like warmup_compile_s: a cold cache
+        # legitimately pays full compiles and must not read as an
+        # availability breach
+        if v is not None and v >= limit and _warm_cache(last) is True:
+            failures.append(
+                f"{key}: {v:g} exceeds the absolute ceiling {limit:g} "
+                "(warm-replica availability contract)")
+    if len(rounds) < 2:
+        return failures
     for key, direction, gated in METRICS:
         if not gated:
             continue

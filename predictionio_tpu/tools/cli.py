@@ -121,6 +121,10 @@ def _apply_read_env(args) -> None:
 def cmd_train(args) -> int:
     _apply_read_env(args)
     _apply_telemetry_env(args)
+    if getattr(args, "compile_cache", ""):
+        # persistent compile cache: the run's new entries export with
+        # the model as a deploy artifact (serving/aot.py)
+        os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
     if getattr(args, "no_auto_resume", False):
         # disable the crashed-run checkpoint scan (workflow/core_workflow)
         os.environ["PIO_AUTO_RESUME"] = "0"
@@ -207,7 +211,11 @@ def cmd_deploy(args) -> int:
         batch_max_delay_ms=args.batch_max_delay_ms,
         batch_max_queue=args.batch_max_queue,
         drain_grace_s=args.drain_grace_s,
+        aot=args.aot,
+        aot_threads=args.aot_threads,
     )
+    if args.compile_cache:
+        os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
     # undeploy a previous server on the same port (CreateServer.scala:260-294)
     if undeploy(args.ip, args.port):
         _info(f"Undeployed previous server at {args.ip}:{args.port}.")
@@ -547,6 +555,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="overlap chunk decode with vocab-encode and "
                          "host->HBM staging (default on; sets "
                          "PIO_READ_OVERLAP / PIO_READ_STAGE)")
+    sp.add_argument("--compile-cache", default="",
+                    help="persistent XLA compile-cache directory; the "
+                         "run's new entries export with the model as a "
+                         "deploy artifact (sets PIO_COMPILE_CACHE_DIR)")
     telemetry_flags(sp)
 
     sp = sub.add_parser("eval", help="run an evaluation")
@@ -577,6 +589,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--drain-grace-s", type=float, default=30.0,
                     help="SIGTERM graceful drain: seconds to wait for "
                          "in-flight batches before exiting")
+    sp.add_argument("--aot", choices=("auto", "on", "off"), default="auto",
+                    help="ahead-of-time compile every (bucket, template, "
+                         "k) serving program before taking traffic "
+                         "(serving/aot.py; PIO_AOT=0/1 overrides)")
+    sp.add_argument("--aot-threads", type=int, default=0,
+                    help="AOT prebuild thread-pool width (0 = "
+                         "PIO_AOT_THREADS or 4)")
+    sp.add_argument("--compile-cache", default="",
+                    help="persistent XLA compile-cache directory to "
+                         "pre-seed from the model's exported cache "
+                         "artifact (sets PIO_COMPILE_CACHE_DIR)")
     telemetry_flags(sp)
 
     sp = sub.add_parser("undeploy", help="stop a deployed engine server")

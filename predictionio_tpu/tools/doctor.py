@@ -13,6 +13,8 @@ every check on one screen with a green/warn/red state:
       breakers    ok    no circuit breaker open
       degraded    ok    0 tainted batches
       recompiles  ok    0 post-warmup XLA recompiles
+      aot         ok    5 programs prebuilt (5 compiled, 0 cached — 0%
+                        hit — in 0.3 s), ready in 0.4 s
       hbm         --    no device memory stats (CPU / unsupported)
       traces      ok    512 spans buffered
     VERDICT: OK
@@ -240,6 +242,46 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
             " (watchdog armed)" if armed else " (still in warmup)")
         checks.append(("recompiles", OK,
                        f"0 post-warmup XLA recompiles{note}"))
+
+    # time-to-ready / AOT prebuild (serving/aot.py) --------------------
+    ttr = metric_max(samples, "pio_time_to_ready_seconds")
+    by_status: Dict[str, float] = {}
+    for labels, v in samples.get("pio_aot_programs_total", []):
+        m = re.search(r'status="([^"]+)"', labels)
+        if m:
+            by_status[m.group(1)] = by_status.get(m.group(1), 0.0) + v
+    aot_debug = device.get("aot") or {}
+    if ttr is None and not by_status and not aot_debug:
+        checks.append(("aot", NA,
+                       "no AOT prebuild recorded (PIO_AOT=0, telemetry "
+                       "off, or not an engine server)"))
+    else:
+        built = int(by_status.get("compiled", 0)
+                    + by_status.get("primed", 0))
+        memoized = int(by_status.get("memoized", 0))
+        failed = int(by_status.get("failed", 0))
+        total = built + memoized + failed
+        prebuild_s = metric_max(samples, "pio_aot_prebuild_seconds")
+        hit = (memoized / total * 100) if total else 0.0
+        detail = (f"{total} programs prebuilt "
+                  f"({built} compiled, {memoized} cached — "
+                  f"{hit:.0f}% hit")
+        if prebuild_s is not None:
+            detail += f" — in {prebuild_s:.1f} s"
+        detail += ")"
+        if ttr is not None:
+            detail += f", ready in {ttr:.1f} s"
+        if failed:
+            checks.append(("aot", RED,
+                           f"{failed} AOT program build(s) FAILED "
+                           "(compiling lazily on the latency path); "
+                           + detail))
+        elif ttr is not None and ttr >= 10.0:
+            checks.append(("aot", WARN,
+                           detail + " — over the 10 s warm-replica "
+                           "target (cold cache? missing artifact?)"))
+        else:
+            checks.append(("aot", OK, detail))
 
     # HBM headroom -----------------------------------------------------
     in_use = metric_sum(samples, "pio_hbm_bytes_in_use")

@@ -96,7 +96,15 @@ def run_train(
     import jax
 
     from predictionio_tpu.common import devicewatch
+    from predictionio_tpu.serving import aot
     devicewatch.install()
+    # compile-cache-as-artifact (serving/aot.py): when a persistent
+    # cache dir is configured, snapshot it now — every entry this run
+    # adds (trainer programs + the model's AOT-built serving programs)
+    # exports with the model so `pio deploy` pre-seeds a warm cache
+    cache_dir = aot.ensure_persistent_cache()
+    cache_before = (model_io.cache_snapshot(cache_dir)
+                    if cache_dir else None)
     if jax.process_count() > 1:
         if resume_from:
             raise ValueError(
@@ -159,6 +167,21 @@ def run_train(
                 check_finite=os.environ.get("PIO_FINITE_CHECK", "1") != "0")
             storage.get_model_data_models().insert(
                 Model(id=instance_id, models=blob))
+        if cache_dir and os.environ.get("PIO_AOT", "") != "0":
+            # AOT-build the model's serving programs from declared
+            # shapes and export the run's compile-cache delta as the
+            # instance's deploy artifact (serving/aot.py). Only with a
+            # persistent cache configured — the built executables ARE
+            # the artifact's payload. Best-effort by contract:
+            # export_train_artifact never raises, so a broken cache dir
+            # cannot fail a finished training.
+            with ctx.phase("aot_export"):
+                _, _, algorithms, _serving = engine._instantiate(
+                    engine_params)
+                aot_summary = aot.export_train_artifact(
+                    storage, instance_id, algorithms, models,
+                    cache_dir, cache_before)
+            logger.info("AOT export: %s", aot_summary)
         phases = dict(ctx.phase_seconds)
         if profile_dir:
             # the telemetry phase table lands NEXT TO the XLA profile so

@@ -84,6 +84,13 @@ class ServerConfig:
     #: the batcher worker to finish every admitted in-flight batch
     #: before the server exits anyway.
     drain_grace_s: float = 30.0
+    #: AOT prebuild (serving/aot.py): "auto"/"on" eagerly compile every
+    #: enumerated (bucket, template, k) serving program before /readyz
+    #: flips ready and mark the recompile watchdog's warmup done; "off"
+    #: keeps lazy first-dispatch compilation. PIO_AOT=0/1 overrides.
+    aot: str = "auto"
+    #: prebuild thread-pool width (0 = PIO_AOT_THREADS or default 4)
+    aot_threads: int = 0
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -195,7 +202,16 @@ class QueryAPI:
         # device observability: compile watchdog + HBM/live-array gauges
         # on this daemon's /metrics and /debug/device.json (idempotent)
         devicewatch.install()
+        #: wall-clock from construction to servable (model loaded, AOT
+        #: prebuild done) — the metric the <10 s warm-replica gate reads
+        self.time_to_ready_s: Optional[float] = None
+        self._aot_state: Optional[Dict[str, Any]] = None
         reg = telemetry.registry()
+        self._m_time_to_ready = reg.gauge(
+            "pio_time_to_ready_seconds",
+            "Deploy wall-clock until servable: model load + device "
+            "placement + AOT program prebuild (serving/aot.py)",
+            labelnames=("server",)).labels(**inst)
         self._m_degraded_queries = reg.counter(
             "pio_degraded_queries_upper_bound",
             "Responses flagged degraded; batch-granular taint makes this "
@@ -217,6 +233,7 @@ class QueryAPI:
 
     # ------------------------------------------------------------- loading
     def _load(self) -> None:
+        t_load = time.perf_counter()
         instance = resolve_engine_instance(self.storage, self.config)
         engine = self._engine_override or get_engine(
             instance.engine_factory, base_dir=self.config.engine_dir)
@@ -233,7 +250,10 @@ class QueryAPI:
             algorithms=algorithms)
         models = [a.prepare_serving(m)
                   for a, m in zip(algorithms, models)]
-        batcher = self._make_batcher(algorithms, models, serving)
+        aot_state, serve_buckets = self._prebuild_aot(
+            instance, algorithms, models)
+        batcher = self._make_batcher(algorithms, models, serving,
+                                     buckets=serve_buckets)
         with self._lock:
             self.engine_instance = instance
             self.engine = engine
@@ -241,14 +261,69 @@ class QueryAPI:
             self.algorithms = algorithms
             self.models = models
             self.serving = serving
+            self._aot_state = aot_state
             old_batcher, self._batcher = self._batcher, batcher
         if old_batcher is not None:   # reload: drain in-flight, then retire
             old_batcher.close()
+        self.time_to_ready_s = time.perf_counter() - t_load
+        self._m_time_to_ready.set(self.time_to_ready_s)
         logger.info("Engine instance %s deployed (%d algorithm(s), "
-                    "batching %s)", instance.id, len(algorithms),
-                    "on" if batcher is not None else "off")
+                    "batching %s, aot %s) in %.2fs", instance.id,
+                    len(algorithms),
+                    "on" if batcher is not None else "off",
+                    "on" if aot_state is not None else "off",
+                    self.time_to_ready_s)
 
-    def _make_batcher(self, algorithms, models, serving):
+    def _prebuild_aot(self, instance, algorithms, models):
+        """Kill the warmup cliff before /readyz flips ready
+        (serving/aot.py): pre-seed the persistent compile cache from
+        the instance's exported artifact, prune the padding-bucket set
+        against observed flush sizes, eagerly build every enumerated
+        serving program on a small thread pool, and mark the recompile
+        watchdog's warmup done — from here on, a serving-path compile
+        is an alarm, not a cliff. Returns (aot summary for `GET /`,
+        bucket set for the batcher); (None, None) with AOT off — wire
+        behavior then stays byte-identical to the pre-AOT server."""
+        from predictionio_tpu.serving import aot
+
+        mode = (self.config.aot or "auto").lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ServerConfig.aot must be auto/on/off, got {mode!r}")
+        if not aot.enabled(mode):
+            devicewatch.note_aot(None)
+            return None, None
+        cache_dir = aot.ensure_persistent_cache()
+        cache_import = None
+        if cache_dir:
+            artifact = self.storage.get_model_data_models().get(
+                model_io.cache_artifact_id(instance.id))
+            if artifact is not None:
+                cache_import = model_io.import_compile_cache(
+                    artifact.models, cache_dir)
+                if cache_import.get("reason"):
+                    logger.warning("compile-cache artifact for %s not "
+                                   "imported: %s", instance.id,
+                                   cache_import["reason"])
+        # this set is handed to the batcher, whose flush-scoped
+        # installation makes every predict_batch pad onto exactly the
+        # programs built below
+        buckets = aot.pruned_serve_buckets(self.config.batch_max_size)
+        specs = []
+        for a, m in zip(algorithms, models):
+            specs.extend(aot.algorithm_programs(a, m, buckets))
+        report = aot.prebuild(specs,
+                              threads=self.config.aot_threads or None)
+        devicewatch.mark_serving_warmup_done()
+        state: Dict[str, Any] = {"enabled": True,
+                                 "buckets": list(buckets),
+                                 **report.summary()}
+        if cache_import is not None:
+            state["cacheImport"] = cache_import
+        devicewatch.note_aot(state)
+        return state, buckets
+
+    def _make_batcher(self, algorithms, models, serving, buckets=None):
         """Build the request micro-batcher for this deployment, or None.
 
         `batching: auto` (the default) engages only when some algorithm
@@ -295,7 +370,8 @@ class QueryAPI:
             flush,
             max_batch_size=self.config.batch_max_size,
             max_delay_ms=self.config.batch_max_delay_ms,
-            max_queue=self.config.batch_max_queue)
+            max_queue=self.config.batch_max_queue,
+            buckets=buckets)
 
     @property
     def stop_requested(self) -> bool:
@@ -396,6 +472,13 @@ class QueryAPI:
         batcher = self._batcher
         out["batching"] = ({"enabled": True, **batcher.stats()}
                            if batcher is not None else {"enabled": False})
+        if self._aot_state is not None:
+            # only with AOT active: a PIO_AOT=0 deploy keeps the exact
+            # legacy key set (wire parity, asserted by test)
+            out["aot"] = {**self._aot_state,
+                          "timeToReadyS": (round(self.time_to_ready_s, 3)
+                                           if self.time_to_ready_s
+                                           is not None else None)}
         return out
 
     def _readyz(self) -> Response:
@@ -411,6 +494,12 @@ class QueryAPI:
             batcher = self._batcher
         checks["modelLoaded"] = instance is not None
         ready &= checks["modelLoaded"]
+        aot_state = self._aot_state
+        if aot_state is not None:
+            # informational: prebuild runs synchronously inside _load,
+            # so by the time this route answers the programs are warm;
+            # failed builds degrade to lazy compile, not unreadiness
+            checks["aotPrograms"] = aot_state.get("programs", 0)
         if batcher is not None:
             depth = batcher.depth()
             checks["queueDepth"] = depth

@@ -15,6 +15,7 @@ jax.tree_util-based.
 from __future__ import annotations
 
 import dataclasses
+import os
 import pickle
 from typing import Any, Callable, List
 
@@ -103,6 +104,123 @@ def serialize_models(models: List[Any], check_finite: bool = False) -> bytes:
 
 def deserialize_models(blob: bytes) -> List[Any]:
     return pickle.loads(blob)
+
+
+# ---------------------------------------------------------------------------
+# compile-cache deploy artifact (serving/aot.py)
+# ---------------------------------------------------------------------------
+#
+# The persistent compile cache (.jax_cache) holds the XLA executables a
+# training run and its model's serving programs compiled; exporting the
+# run's new entries next to the model blob lets `pio deploy` pre-seed a
+# cold replica's cache and skip minutes of backend compiles. Cache keys
+# bake in the jaxlib version and platform, so the artifact records that
+# fingerprint and import SKIPS (never errors) on mismatch — a stale
+# artifact degrades to lazy compilation (KNOWN_ISSUES #9).
+
+#: a single cache entry larger than this is almost certainly not one of
+#: ours (the full hybrid trainer is ~10s of MB); cap the artifact so a
+#: shared cache dir can't balloon the Models store
+_CACHE_ENTRY_MAX_BYTES = 256 * 1024 * 1024
+
+
+def cache_artifact_id(instance_id: str) -> str:
+    """Models-store key of an instance's compile-cache artifact (kept
+    separate from the model blob so pre-artifact readers see exactly
+    the rows they always did)."""
+    return f"{instance_id}.jaxcache"
+
+
+def cache_fingerprint() -> dict:
+    """The environment attributes jax's cache keys depend on; an
+    artifact only imports into a matching environment."""
+    import jaxlib
+
+    return {
+        "jax": getattr(jax, "__version__", "?"),
+        "jaxlib": getattr(jaxlib, "__version__", "?"),
+        "backend": jax.default_backend(),
+    }
+
+
+def cache_snapshot(cache_dir: str) -> frozenset:
+    """Filenames currently in the persistent cache directory (the
+    before/after delta is what a training run exports)."""
+    try:
+        return frozenset(
+            f for f in os.listdir(cache_dir)
+            if os.path.isfile(os.path.join(cache_dir, f)))
+    except OSError:
+        return frozenset()
+
+
+def export_compile_cache(cache_dir: str,
+                         since: Any = None) -> "bytes | None":
+    """Pack the cache entries added since ``since`` (a
+    :func:`cache_snapshot`; None = everything) into an artifact blob.
+    Returns None when there is nothing to export."""
+    names = cache_snapshot(cache_dir)
+    if since:
+        names = names - frozenset(since)
+    entries = {}
+    for name in sorted(names):
+        path = os.path.join(cache_dir, name)
+        try:
+            if os.path.getsize(path) > _CACHE_ENTRY_MAX_BYTES:
+                continue
+            with open(path, "rb") as f:
+                entries[name] = f.read()
+        except OSError:
+            continue
+    if not entries:
+        return None
+    return pickle.dumps(
+        {"format": "pio-jaxcache-v1", "meta": cache_fingerprint(),
+         "entries": entries},
+        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def import_compile_cache(blob: bytes, cache_dir: str) -> dict:
+    """Pre-seed ``cache_dir`` from an exported artifact.
+
+    Graceful by contract: a corrupt blob, a jaxlib/platform mismatch,
+    or an unwritable directory returns a summary with ``skipped`` —
+    deploy then compiles lazily exactly as before the artifact existed.
+    Existing files are never overwritten (the local cache is at least
+    as fresh)."""
+    summary = {"imported": 0, "skipped": 0, "reason": ""}
+    try:
+        artifact = pickle.loads(blob)
+        if (not isinstance(artifact, dict)
+                or artifact.get("format") != "pio-jaxcache-v1"):
+            summary["reason"] = "unrecognized artifact format"
+            return summary
+        meta = artifact.get("meta") or {}
+        here = cache_fingerprint()
+        if meta != here:
+            summary["skipped"] = len(artifact.get("entries") or {})
+            summary["reason"] = (
+                f"environment mismatch (artifact {meta}, this process "
+                f"{here}); compiling lazily")
+            return summary
+        os.makedirs(cache_dir, exist_ok=True)
+        for name, data in (artifact.get("entries") or {}).items():
+            # refuse path traversal from a hostile blob
+            if os.path.basename(name) != name or name.startswith("."):
+                summary["skipped"] += 1
+                continue
+            path = os.path.join(cache_dir, name)
+            if os.path.exists(path):
+                summary["skipped"] += 1
+                continue
+            tmp = path + ".pio_tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            summary["imported"] += 1
+    except Exception as e:
+        summary["reason"] = (f"{type(e).__name__}: {e}; compiling lazily")
+    return summary
 
 
 def device_put_tree(obj: Any, sharding=None) -> Any:

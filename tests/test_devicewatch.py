@@ -318,10 +318,16 @@ _SECTIONS = ("health", "readiness", "queue", "serving", "breakers",
 def test_doctor_green_against_live_query_server(memory_storage,
                                                 monkeypatch):
     monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")
+    # declare the k this test serves: the AOT prebuild (serving/aot.py)
+    # marks warmup done at deploy, so a query at an UNDECLARED k would
+    # correctly trip the recompile alarm — the green path is a deploy
+    # whose declared programs cover its traffic
+    monkeypatch.setenv("PIO_AOT_KS", "4")
     telemetry.set_enabled(True)
     _clear_counter_family("pio_xla_post_warmup_recompiles_total")
     _clear_counter_family("pio_batcher_rejected_total")
     _clear_counter_family("pio_degraded_batches_total")
+    _clear_counter_family("pio_aot_programs_total")
     engine = _train_engine(memory_storage)
     api = QueryAPI(storage=memory_storage, engine=engine,
                    config=ServerConfig(batching="on"))
@@ -393,6 +399,12 @@ def test_doctor_unreachable_exits_2():
 
 def test_doctor_cli_wiring(memory_storage):
     from predictionio_tpu.tools.cli import main as cli_main
+    # the registry is process-global and additive: earlier tests that
+    # deliberately served undeclared ks past the AOT warmup mark (or
+    # exercised failing AOT builds) left alarm counts this green path
+    # must not inherit
+    _clear_counter_family("pio_xla_post_warmup_recompiles_total")
+    _clear_counter_family("pio_aot_programs_total")
     api = EventAPI(storage=memory_storage)
     server, port = serve_background(api)
     try:

@@ -653,12 +653,14 @@ def test_responses_byte_identical_with_telemetry_on_and_off(memory_storage):
         telemetry.set_enabled(True)
         st_on, on = api.handle("POST", "/queries.json", body=body)
         assert (st_off, json.dumps(off)) == (st_on, json.dumps(on))
-        # legacy GET / key set unchanged (no telemetry keys leak in)
+        # legacy GET / key set unchanged (no telemetry keys leak in;
+        # "aot" is the AOT-deploy section, present because this server
+        # prebuilt its programs — PIO_AOT=0 parity is tests/test_aot.py)
         _, info = api.handle("GET", "/")
         assert set(info) == {
             "status", "engineInstance", "algorithms", "requestCount",
             "avgServingSec", "lastServingSec", "degradedCount", "draining",
-            "serverStartTime", "batching"}
+            "serverStartTime", "batching", "aot"}
     finally:
         telemetry.set_enabled(None)
         api.close()

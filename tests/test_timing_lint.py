@@ -90,7 +90,7 @@ def test_no_wall_clock_time_in_package():
 #: kernels may legitimately use it for non-timing dispatch control.)
 _TIMED_MODULES = (
     "common/telemetry.py", "common/tracing.py", "common/devicewatch.py",
-    "serving/batcher.py",
+    "serving/batcher.py", "serving/aot.py",
     "workflow/context.py", "workflow/core_workflow.py",
     "workflow/create_server.py", "data/store.py", "ops/staging.py",
     "models/recommendation/als_algorithm.py",
