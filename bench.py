@@ -776,6 +776,129 @@ def measure_telemetry(storage, engine, n_conns: int = 8,
     }
 
 
+def measure_waterfall(storage, engine, n_conns: int = 8,
+                      queries_per_client: int = 100):
+    """Waterfall leg (common/waterfall.py): the same batched serving
+    path with PIO_WATERFALL off vs on (telemetry ON in both legs — the
+    realistic production baseline), then a /debug/slow.json read whose
+    stage breakdown lands in the JSON detail.
+
+    The acceptance gate: stage sampling must cost <= 5% p99 versus
+    sampling off (absolute floor 0.2 ms, like the telemetry leg — CPU
+    sub-noise deltas must not trip the ratio). Hard-fails under
+    BENCH_STRICT_EXTRAS=1."""
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.common import telemetry as _telemetry
+    from predictionio_tpu.common import waterfall
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+    def leg(waterfall_on: bool):
+        _telemetry.set_enabled(True)
+        waterfall.set_enabled(waterfall_on)
+        waterfall.clear()
+        try:
+            api = QueryAPI(storage=storage, engine=engine,
+                           config=ServerConfig(batching="on"))
+            server = make_server(api, "127.0.0.1", 0)
+            port = server.server_address[1]
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            lat_lock = threading.Lock()
+            lat: list = []
+            errors: list = []
+            barrier = threading.Barrier(n_conns + 1)
+
+            def client(cx):
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    my = []
+                    barrier.wait()
+                    for q in range(queries_per_client):
+                        body = json.dumps(
+                            {"user": f"u{(cx * 131 + q * 17) % 1000}",
+                             "num": 10})
+                        t0 = time.perf_counter()
+                        conn.request(
+                            "POST", "/queries.json", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        my.append(time.perf_counter() - t0)
+                        assert resp.status == 200, payload[:200]
+                    conn.close()
+                    with lat_lock:
+                        lat.extend(my)
+                except Exception as e:
+                    errors.append(e)
+
+            slow = None
+            try:
+                threads = [threading.Thread(target=client, args=(cx,))
+                           for cx in range(n_conns)]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                if waterfall_on:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.request("GET", "/debug/slow.json?limit=8")
+                    resp = conn.getresponse()
+                    assert resp.status == 200, "slow.json read failed"
+                    slow = json.loads(resp.read().decode("utf-8"))
+                    conn.close()
+            finally:
+                server.shutdown()
+                api.close()
+            lat_ms = np.asarray(lat) * 1e3
+            return {"p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                    }, slow
+        finally:
+            _telemetry.set_enabled(None)
+            waterfall.set_enabled(None)
+
+    off, _ = leg(False)
+    on, slow = leg(True)
+    reqs = (slow or {}).get("requests") or []
+    if not reqs:
+        raise RuntimeError("waterfall leg served traffic but "
+                           "/debug/slow.json recorded no requests")
+    slowest = reqs[0]
+    stages = slowest.get("stages") or {}
+    expected = {"admission", "supplement", "dispatch", "merge",
+                "serialize"}
+    if not expected <= set(stages):
+        raise RuntimeError(
+            f"slow.json stage breakdown incomplete: {sorted(stages)}")
+    overhead_ok = (on["p99_ms"] <= off["p99_ms"] * 1.05
+                   or on["p99_ms"] - off["p99_ms"] <= 0.2)
+    return {
+        "waterfall_off": off,
+        "waterfall_on": on,
+        "waterfall_on_p99_ms": on["p99_ms"],
+        "waterfall_overhead_p99_pct": round(
+            (on["p99_ms"] / max(off["p99_ms"], 1e-9) - 1.0) * 100, 2),
+        "waterfall_overhead_ok": bool(overhead_ok),
+        "waterfall_slow_ring": len(reqs),
+        "waterfall_slowest": {
+            "total_ms": slowest.get("totalMs"),
+            "trace_id": slowest.get("traceId"),
+            "stages_ms": stages,
+            "details": slowest.get("details"),
+        },
+    }
+
+
 def measure_recompile_watch(storage, engine, warmup_queries: int = 24,
                             steady_queries: int = 48):
     """Recompile-watchdog leg (common/devicewatch.py): deploy the engine
@@ -873,9 +996,43 @@ def measure_time_to_ready(storage, engine):
             "aot_failed": a.get("failed"),
             "first_query_after_ready_ms": round(first_ms, 3),
         })
+        # <instance>.jaxcache artifact round-trip verification (the
+        # ROADMAP item-2 follow-up): export the train's artifact blob
+        # into a FRESH directory and record what imported — on the
+        # tunneled TPU platform this is the per-round receipt that the
+        # deploy-side pre-seed genuinely lands entry-for-entry
+        out["cache_artifact_roundtrip"] = _cache_artifact_roundtrip(
+            storage, api.engine_instance.id)
     finally:
         api.close()
     return out
+
+
+def _cache_artifact_roundtrip(storage, instance_id: str):
+    """Import the instance's compile-cache artifact into a throwaway dir
+    and report {present, bytes, imported, skipped, reason}."""
+    import tempfile
+
+    from predictionio_tpu.workflow import model_io
+
+    art = storage.get_model_data_models().get(
+        model_io.cache_artifact_id(instance_id))
+    if art is None:
+        return {"present": False}
+    fresh = tempfile.mkdtemp(prefix="pio-cache-rt-")
+    try:
+        summary = model_io.import_compile_cache(art.models, fresh)
+        return {"present": True, "bytes": len(art.models),
+                "imported": summary.get("imported", 0),
+                "skipped": summary.get("skipped", 0),
+                "reason": summary.get("reason") or None,
+                "ok": (not summary.get("reason")
+                       and summary.get("imported", 0) > 0)}
+    except Exception as e:
+        return {"present": True, "bytes": len(art.models),
+                "ok": False, "reason": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(fresh, ignore_errors=True)
 
 
 def serve_and_measure(storage, engine, n_queries: int = 200):
@@ -1126,6 +1283,16 @@ def main() -> None:
                 telem = {"telemetry_error": f"{type(e).__name__}: {e}",
                          "telemetry_scrape_ok": False}
 
+        # waterfall leg (common/waterfall.py): stage sampling off vs on
+        # through the same batched path + a /debug/slow.json read; the
+        # sampled path's p99 tax gates at <= 5% under strict extras
+        wf = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                wf = measure_waterfall(storage, engine)
+            except Exception as e:
+                wf = {"waterfall_error": f"{type(e).__name__}: {e}"}
+
         # recompile-watchdog leg (common/devicewatch.py): after a warmup
         # burst the standard bucketed serving path must compile NOTHING —
         # a nonzero count is the padding-bucket p99 cliff, strict-fatal
@@ -1262,6 +1429,7 @@ def main() -> None:
                 **(ttr_leg or {}),
                 **(throughput or {}),
                 **(telem or {}),
+                **(wf or {}),
                 **(recompile_watch or {}),
                 **(eval_grid or {}),
                 **(ecom or {}),
@@ -1344,6 +1512,18 @@ def main() -> None:
                     f"({telem['telemetry_on']['p99_ms']} ms) exceeds "
                     "metrics-off "
                     f"({telem['telemetry_off']['p99_ms']} ms) by >5% "
+                    "with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and wf:
+            if wf.get("waterfall_error"):
+                failures.append(
+                    f"waterfall leg crashed ({wf['waterfall_error']}) "
+                    "with BENCH_STRICT_EXTRAS=1")
+            elif not wf.get("waterfall_overhead_ok"):
+                failures.append(
+                    "waterfall-on p99 "
+                    f"({wf['waterfall_on']['p99_ms']} ms) exceeds "
+                    "sampling-off "
+                    f"({wf['waterfall_off']['p99_ms']} ms) by >5% "
                     "with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and \
                 recompile_watch is not None:

@@ -276,8 +276,7 @@ def _family_sum(name: str) -> float:
         fam = reg._families.get(name)
     if fam is None:
         return 0.0
-    return sum(v for sample_name, _labels, v in fam.samples()
-               if sample_name == name)
+    return sum(s[2] for s in fam.samples() if s[0] == name)
 
 
 def compiles_total() -> int:

@@ -1,0 +1,293 @@
+"""On-demand device profiling for long-lived daemons.
+
+The deploy server is a production daemon (PAPER.md's ``pio deploy``) —
+"restart it with ``--profile``" is not an acceptable way to capture an
+XLA/device trace from a replica that is slow RIGHT NOW. This module
+gives every daemon a bounded capture endpoint:
+
+    POST /debug/profile?ms=2000[&dir=...]   start a capture (202), or
+                                            409 while one is running
+    GET  /debug/profile                     list captures + active state
+
+A capture wraps ``jax.profiler.start_trace``/``stop_trace`` around a
+timer thread:
+
+- **Hard max duration** — ``ms`` is clamped to ``PIO_PROFILE_MAX_MS``
+  (default 10 000); a typo'd ``ms=9999999`` cannot wedge the daemon in
+  profiling overhead for hours.
+- **Single concurrent capture** — the JAX profiler is process-global,
+  so a second POST while one runs answers 409 instead of corrupting the
+  first. ``pio train --profile DIR`` shares the same guard via
+  :func:`trace`.
+- **Artifacts on disk, listed not streamed** — each capture lands in
+  ``<base>/<capture-id>/`` (``PIO_PROFILE_DIR``, default
+  ``<tmp>/pio-profiles``) in the standard xprof/tensorboard layout plus
+  a ``capture.json`` metadata file; ``GET /debug/profile`` lists paths
+  and sizes. The operator opens the trace with xprof — the daemon never
+  serves multi-MB protobufs on its request path.
+
+``pio profile <url> --ms 2000`` (tools/profile.py) drives the endpoint
+against a live server and waits for the artifact listing.
+
+Training captures (``pio train --profile DIR``) go through
+:func:`trace` so serving and training profiles share one artifact
+format (same ``capture.json`` next to the same xprof layout).
+
+Overhead caveat (KNOWN_ISSUES #10): a running capture taxes every
+dispatch; on the CPU backend the device timeline is host threads only.
+
+jax is imported lazily — importing this module from a daemon that never
+profiles costs nothing, and a capture attempt on a stripped runtime
+degrades to a clean 503.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("predictionio_tpu.profiling")
+
+DEFAULT_MS = 2000
+_HISTORY = 16
+
+_lock = threading.Lock()
+_active: Optional[Dict[str, Any]] = None
+_captures: List[Dict[str, Any]] = []
+
+
+class CaptureBusy(Exception):
+    """A capture is already running (the profiler is process-global)."""
+
+
+def max_ms() -> int:
+    raw = os.environ.get("PIO_PROFILE_MAX_MS", "")
+    try:
+        return max(1, int(raw)) if raw else 10_000
+    except ValueError:
+        return 10_000
+
+
+def base_dir() -> str:
+    return (os.environ.get("PIO_PROFILE_DIR")
+            or os.path.join(tempfile.gettempdir(), "pio-profiles"))
+
+
+def _now_iso() -> str:
+    return _dt.datetime.now(_dt.timezone.utc).isoformat(timespec="seconds")
+
+
+def _artifact_listing(path: str) -> Tuple[List[str], int]:
+    """(relative file paths, total bytes) under a capture directory."""
+    files: List[str] = []
+    total = 0
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            full = os.path.join(root, name)
+            try:
+                total += os.path.getsize(full)
+            except OSError:
+                continue
+            files.append(os.path.relpath(full, path))
+    return sorted(files), total
+
+
+def _write_metadata(entry: Dict[str, Any]) -> None:
+    """capture.json next to the xprof artifact — the shared format for
+    serving (/debug/profile) and training (pio train --profile)."""
+    try:
+        with open(os.path.join(entry["dir"], "capture.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(entry, f, indent=2, sort_keys=True)
+    except OSError:
+        logger.warning("could not write capture metadata under %s",
+                       entry["dir"], exc_info=True)
+
+
+def _begin(label: str, requested_ms: Optional[int],
+           out_dir: Optional[str]) -> Dict[str, Any]:
+    """Reserve the profiler and start the JAX trace; raises CaptureBusy
+    or ValueError (bad dir / stripped runtime)."""
+    global _active
+    entry = {
+        "id": f"{label}-{uuid.uuid4().hex[:8]}",
+        "label": label,
+        "startedAt": _now_iso(),
+        "requestedMs": requested_ms,
+        "state": "running",
+    }
+    entry["dir"] = os.path.join(out_dir or base_dir(), entry["id"])
+    with _lock:
+        if _active is not None:
+            raise CaptureBusy(
+                f"capture {_active['id']} is already running")
+        _active = entry
+    try:
+        os.makedirs(entry["dir"], exist_ok=True)
+        import jax
+        jax.profiler.start_trace(entry["dir"])
+    except BaseException as e:
+        with _lock:
+            _active = None
+        raise ValueError(f"could not start profiler trace: {e}") from e
+    entry["_t0"] = time.perf_counter()
+    return entry
+
+
+def _finish(entry: Dict[str, Any]) -> Dict[str, Any]:
+    # finalize on a LOCAL copy: a concurrent GET /debug/profile reads
+    # the shared entry as "running" until the swap below, never a
+    # half-finished record
+    global _active
+    final = {k: v for k, v in entry.items() if not k.startswith("_")}
+    try:
+        import jax
+        jax.profiler.stop_trace()
+        final["state"] = "done"
+    except BaseException as e:   # must release the slot regardless
+        final["state"] = "failed"
+        final["error"] = f"{type(e).__name__}: {e}"
+        logger.exception("profiler stop_trace failed")
+    final["durationMs"] = round(
+        (time.perf_counter() - entry["_t0"]) * 1e3, 1)
+    files, total = _artifact_listing(final["dir"])
+    final["files"] = files
+    final["bytes"] = total
+    if final["state"] == "done" and not files:
+        final["state"] = "empty"
+    _write_metadata(final)
+    with _lock:
+        _active = None
+        _captures.append(final)
+        del _captures[:-_HISTORY]
+    return final
+
+
+def start_capture(ms: Optional[int] = None,
+                  out_dir: Optional[str] = None,
+                  label: str = "serve") -> Dict[str, Any]:
+    """Start a bounded background capture; returns the running entry.
+    A timer thread stops the trace after ``min(ms, PIO_PROFILE_MAX_MS)``
+    and files the artifact listing. Raises CaptureBusy / ValueError."""
+    requested = DEFAULT_MS if ms is None else int(ms)
+    if requested < 1:
+        raise ValueError(f"ms must be >= 1, got {requested}")
+    bounded = min(requested, max_ms())
+    entry = _begin(label, bounded, out_dir)
+    timer = threading.Timer(bounded / 1e3, _finish, args=(entry,))
+    timer.daemon = True
+    timer.start()
+    return {k: v for k, v in entry.items() if not k.startswith("_")}
+
+
+class trace:
+    """Context manager: a SYNCHRONOUS capture around a block (the
+    ``pio train --profile DIR`` path), sharing the endpoint's
+    single-capture guard and artifact format. ``capture_dir`` is used
+    as-is (the operator named it), with capture.json written inside."""
+
+    def __init__(self, capture_dir: str, label: str = "train"):
+        self.capture_dir = capture_dir
+        self.label = label
+        self._entry: Optional[Dict[str, Any]] = None
+
+    def __enter__(self) -> "trace":
+        global _active
+        entry = {
+            "id": f"{self.label}-{uuid.uuid4().hex[:8]}",
+            "label": self.label,
+            "startedAt": _now_iso(),
+            "requestedMs": None,
+            "state": "running",
+            "dir": self.capture_dir,
+        }
+        with _lock:
+            if _active is not None:
+                raise CaptureBusy(
+                    f"capture {_active['id']} is already running")
+            _active = entry
+        try:
+            os.makedirs(entry["dir"], exist_ok=True)
+            import jax
+            jax.profiler.start_trace(entry["dir"])
+        except BaseException as e:
+            with _lock:
+                _active = None
+            raise ValueError(
+                f"could not start profiler trace: {e}") from e
+        entry["_t0"] = time.perf_counter()
+        self._entry = entry
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._entry is not None:
+            _finish(self._entry)
+
+
+def list_captures() -> Dict[str, Any]:
+    """The ``GET /debug/profile`` payload: base dir, hard cap, the
+    running capture (if any), and the recent history, newest first."""
+    with _lock:
+        active = ({k: v for k, v in _active.items()
+                   if not k.startswith("_")}
+                  if _active is not None else None)
+        history = [dict(c) for c in reversed(_captures)]
+    return {"dir": base_dir(), "maxMs": max_ms(),
+            "active": active, "captures": history}
+
+
+def get_capture(capture_id: str) -> Optional[Dict[str, Any]]:
+    with _lock:
+        if _active is not None and _active["id"] == capture_id:
+            return {k: v for k, v in _active.items()
+                    if not k.startswith("_")}
+        for c in _captures:
+            if c["id"] == capture_id:
+                return dict(c)
+    return None
+
+
+def reset() -> None:
+    """Forget capture history and force-release the slot (tests). If a
+    trace is genuinely running this does NOT stop it — tests that
+    started one must wait for its timer."""
+    global _active
+    with _lock:
+        _active = None
+        _captures.clear()
+
+
+# ---------------------------------------------------------------------------
+# route handler (telemetry.handle_route delegates /debug/profile here)
+# ---------------------------------------------------------------------------
+
+def handle_route(method: str, query: Optional[Dict[str, str]] = None):
+    """(status, payload) for the /debug/profile endpoint on any daemon."""
+    if method == "GET":
+        return 200, list_captures()
+    if method != "POST":
+        return 405, {"message": "method not allowed"}
+    q = query or {}
+    raw_ms = q.get("ms", "")
+    try:
+        ms = int(raw_ms) if raw_ms else DEFAULT_MS
+    except ValueError:
+        return 400, {"message": f"ms must be an integer, got {raw_ms!r}"}
+    try:
+        entry = start_capture(ms=ms, out_dir=q.get("dir") or None)
+    except CaptureBusy as e:
+        return 409, {"message": str(e)}
+    except ValueError as e:
+        # bad ms, unwritable dir, or a stripped runtime without the
+        # profiler: the daemon stays healthy either way
+        status = 400 if "ms must be" in str(e) else 503
+        return status, {"message": str(e)}
+    return 202, {"capture": entry,
+                 "boundedMs": min(max(ms, 1), max_ms())}

@@ -1,0 +1,273 @@
+"""SLO engine: error budgets and burn rates, evaluated at scrape time.
+
+PRs 4-6 export raw counters; nothing in the stack says "you are burning
+this month's error budget 20x too fast". This module evaluates two
+objectives over the registry (Google-SRE multiwindow burn-rate style,
+SRE Workbook ch. 5) and exports the verdict as gauges every scrape:
+
+- **availability** — fraction of HTTP responses that are not 5xx
+  (``pio_http_requests_total{service,status}``), target
+  ``PIO_SLO_AVAILABILITY`` (default 0.999).
+- **latency** — fraction of served queries at or under
+  ``PIO_SLO_LATENCY_MS`` (default 25 ms, snapped to a
+  ``pio_serve_seconds`` bucket edge at or below it), target
+  ``PIO_SLO_LATENCY_TARGET`` (default 0.99).
+
+Exported series (scrape-time collector, same pattern as devicewatch's
+device gauges; nothing is emitted until ``PIO_TELEMETRY=1`` — wire
+parity):
+
+    pio_slo_target{slo}                    the objective
+    pio_slo_error_budget_remaining{slo}    1 = untouched, 0 = spent,
+                                           negative = overspent
+                                           (process-lifetime window)
+    pio_slo_burn_rate{slo,window}          error rate / allowed error
+                                           rate over the fast
+                                           (PIO_SLO_FAST_WINDOW_S, 300)
+                                           and slow
+                                           (PIO_SLO_SLOW_WINDOW_S, 3600)
+                                           windows; 1.0 = exactly on
+                                           budget
+
+Burn thresholds follow the SRE Workbook pages: fast-window burn >= 14.4
+is the page (`pio doctor` goes RED), slow-window burn >= 6 is the
+ticket (WARN). Windowed rates come from a bounded history of scrape
+snapshots — the engine records (monotonic time, good, total) per
+objective each scrape and differences against the snapshot just outside
+the window, so any scraper cadence works and an idle window burns 0.
+
+Targets come from ``ServerConfig`` (``pio deploy --slo-availability /
+--slo-latency-ms``) or the env; the engine is process-wide like the
+registry it reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from predictionio_tpu.common import telemetry
+
+#: SRE Workbook multiwindow thresholds: page on fast burn, ticket on slow
+FAST_BURN_RED = 14.4
+SLOW_BURN_WARN = 6.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Objective targets + burn windows (env-defaulted; ServerConfig
+    overrides ride through :func:`install`)."""
+    availability: float = 0.999
+    latency_ms: float = 25.0
+    latency_target: float = 0.99
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+
+    @classmethod
+    def from_env(cls, availability: Optional[float] = None,
+                 latency_ms: Optional[float] = None,
+                 latency_target: Optional[float] = None) -> "SLOConfig":
+        return cls(
+            availability=(availability if availability is not None
+                          else _env_float("PIO_SLO_AVAILABILITY", 0.999)),
+            latency_ms=(latency_ms if latency_ms is not None
+                        else _env_float("PIO_SLO_LATENCY_MS", 25.0)),
+            latency_target=(latency_target if latency_target is not None
+                            else _env_float("PIO_SLO_LATENCY_TARGET", 0.99)),
+            fast_window_s=_env_float("PIO_SLO_FAST_WINDOW_S", 300.0),
+            slow_window_s=_env_float("PIO_SLO_SLOW_WINDOW_S", 3600.0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# registry readers (cumulative good/total per objective)
+# ---------------------------------------------------------------------------
+
+def _availability_counts() -> Tuple[float, float]:
+    """(good, total) across every daemon in this process: non-5xx
+    responses over all responses."""
+    reg = telemetry.registry()
+    with reg._lock:
+        fam = reg._families.get("pio_http_requests_total")
+    if fam is None:
+        return 0.0, 0.0
+    good = total = 0.0
+    for name, labels, value, *_ in fam.samples():
+        if name != "pio_http_requests_total":
+            continue
+        status = dict(labels).get("status", "")
+        total += value
+        if not status.startswith("5"):
+            good += value
+    return good, total
+
+
+def _latency_counts(threshold_s: float) -> Tuple[float, float]:
+    """(good, total) from the pio_serve_seconds histogram: good = served
+    at or under the largest bucket edge <= threshold (cumulative bucket
+    counts sum safely across label sets)."""
+    reg = telemetry.registry()
+    with reg._lock:
+        fam = reg._families.get("pio_serve_seconds")
+    if fam is None or fam.kind != "histogram":
+        return 0.0, 0.0
+    with fam._lock:
+        children = list(fam._children.values())
+    good = total = 0.0
+    for child in children:
+        snap = child.snapshot()
+        total += snap["count"]
+        under = 0.0
+        for ub, cum in snap["buckets"].items():
+            if ub <= threshold_s:
+                under = max(under, cum)
+        good += under
+    return good, total
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class SLOEngine:
+    """Evaluates the objectives against the registry; keeps a bounded
+    snapshot history for the windowed burn rates."""
+
+    def __init__(self, config: Optional[SLOConfig] = None):
+        self.config = config or SLOConfig.from_env()
+        self._lock = threading.Lock()
+        #: per-objective deque of (monotonic_s, good, total)
+        self._history: Dict[str, deque] = {
+            "availability": deque(maxlen=4096),
+            "latency": deque(maxlen=4096),
+        }
+
+    # -------------------------------------------------------------- windows
+    def _window_rate(self, history: deque, now: float, good: float,
+                     total: float, window_s: float) -> float:
+        """Observed BAD fraction over the trailing window (0 when the
+        window saw no traffic). A brand-new engine (no snapshot yet)
+        claims NO burn rather than judging the process's whole lifetime
+        as one window — the baseline forms at the first scrape and real
+        rates start at the second."""
+        if not history:
+            return 0.0
+        base: Optional[Tuple[float, float, float]] = None
+        for t, g, n in reversed(history):
+            if now - t >= window_s:
+                base = (t, g, n)
+                break
+        if base is None:
+            # window extends past recorded history: difference against
+            # the oldest snapshot (partial-window coverage)
+            base = history[0]
+        d_total = total - base[2]
+        if d_total <= 0:
+            return 0.0
+        d_bad = (total - good) - (base[2] - base[1])
+        return max(0.0, d_bad / d_total)
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Evaluate both objectives, append the snapshot, and return
+        {slo: {target, good, total, budget_remaining,
+        burn_fast, burn_slow}}."""
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        counts = {
+            "availability": (_availability_counts(), cfg.availability),
+            "latency": (_latency_counts(cfg.latency_ms / 1e3),
+                        cfg.latency_target),
+        }
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for slo, ((good, total), target) in counts.items():
+                history = self._history[slo]
+                allowed = max(1.0 - target, 1e-9)
+                bad_ratio = ((total - good) / total) if total > 0 else 0.0
+                fast = self._window_rate(history, now, good, total,
+                                         cfg.fast_window_s) / allowed
+                slow = self._window_rate(history, now, good, total,
+                                         cfg.slow_window_s) / allowed
+                history.append((now, good, total))
+                # prune entries older than the slow window (plus one
+                # kept just outside it as the differencing base)
+                while (len(history) > 2
+                       and now - history[1][0] > cfg.slow_window_s):
+                    history.popleft()
+                out[slo] = {
+                    "target": target,
+                    "good": good,
+                    "total": total,
+                    "budget_remaining": 1.0 - bad_ratio / allowed,
+                    "burn_fast": fast,
+                    "burn_slow": slow,
+                }
+        return out
+
+    # ------------------------------------------------------------ collector
+    def collect(self) -> Iterable[str]:
+        """Scrape-time exposition lines (registered on the registry like
+        devicewatch's device gauges). Emits nothing until telemetry is
+        on — no new series by default, wire parity."""
+        if not telemetry.on():
+            return []
+        verdict = self.evaluate()
+        lines: List[str] = [
+            "# TYPE pio_slo_target gauge",
+            "# TYPE pio_slo_error_budget_remaining gauge",
+            "# TYPE pio_slo_burn_rate gauge",
+            f"pio_slo_latency_threshold_ms {self.config.latency_ms:g}",
+        ]
+        for slo, v in sorted(verdict.items()):
+            lines.append(f'pio_slo_target{{slo="{slo}"}} {v["target"]:g}')
+            lines.append(
+                f'pio_slo_error_budget_remaining{{slo="{slo}"}} '
+                f'{v["budget_remaining"]:.6g}')
+            for window in ("fast", "slow"):
+                lines.append(
+                    f'pio_slo_burn_rate{{slo="{slo}",window="{window}"}} '
+                    f'{v["burn_" + window]:.6g}')
+        return lines
+
+
+_engine: Optional[SLOEngine] = None
+_install_lock = threading.Lock()
+
+
+def install(config: Optional[SLOConfig] = None) -> SLOEngine:
+    """Create (or reconfigure) the process SLO engine and register its
+    collector. Every daemon constructor calls this next to
+    devicewatch.install(); an explicit config (the query server's
+    ServerConfig targets) wins over a default env install — the query
+    daemon is the one whose SLOs the operator configured."""
+    global _engine
+    with _install_lock:
+        if _engine is None:
+            _engine = SLOEngine(config)
+        elif config is not None:
+            _engine.config = config
+    telemetry.registry().register_collector(_engine.collect)
+    return _engine
+
+
+def engine() -> Optional[SLOEngine]:
+    return _engine
+
+
+def reset() -> None:
+    """Drop the engine (tests); the next install() starts fresh."""
+    global _engine
+    with _install_lock:
+        _engine = None

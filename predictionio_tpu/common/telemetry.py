@@ -132,9 +132,16 @@ class Histogram:
 
     ``buckets`` are upper bounds (``+Inf`` is implicit). ``observe`` is a
     linear scan over a short tuple + two adds under the child lock —
-    no allocation, no sorting, hot-path safe."""
+    no allocation, no sorting, hot-path safe.
 
-    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count")
+    Exemplars: ``observe(v, exemplar=trace_id)`` makes the landing
+    bucket remember the most recent trace id (+ its value), exposed in
+    OpenMetrics exemplar syntax on the ``_bucket`` line — the waterfall
+    stage histograms use this so an alert on a bucket leads straight to
+    a concrete request in ``/debug/slow.json`` / ``/traces.json``."""
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
         bs = tuple(sorted(float(b) for b in buckets))
@@ -145,8 +152,12 @@ class Histogram:
         self._counts = [0] * (len(bs) + 1)  # +1 = the +Inf bucket
         self._sum = 0.0
         self._count = 0
+        #: per-bucket (exemplar_id, observed_value) — most recent wins;
+        #: stays None (no storage, no exposition) until one is recorded
+        self._exemplars: Optional[list] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(value)
         i = 0
         for b in self.buckets:        # outside the lock: read-only tuple
@@ -157,6 +168,10 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * len(self._counts)
+                self._exemplars[i] = (str(exemplar), v)
 
     def snapshot(self) -> Dict[str, Any]:
         """(cumulative bucket counts keyed by upper bound, sum, count)."""
@@ -181,10 +196,16 @@ class Histogram:
             return self._count
 
     def _samples(self, name, labels):
+        # bucket samples carry a 4th element — the bucket's exemplar
+        # (or None); consumers that unpack 3-tuples use `*_` or slices
         snap = self.snapshot()
-        for ub, c in snap["buckets"].items():
+        with self._lock:
+            exemplars = (list(self._exemplars)
+                         if self._exemplars is not None else None)
+        for i, (ub, c) in enumerate(snap["buckets"].items()):
             le = "+Inf" if ub == _INF else _fmt_number(ub)
-            yield (name + "_bucket", labels + (("le", le),), c)
+            ex = exemplars[i] if exemplars is not None else None
+            yield (name + "_bucket", labels + (("le", le),), c, ex)
         yield (name + "_sum", labels, snap["sum"])
         yield (name + "_count", labels, snap["count"])
 
@@ -369,13 +390,22 @@ class MetricsRegistry:
             if fam.help:
                 out.append(f"# HELP {fam.name} {fam.help}")
             out.append(f"# TYPE {fam.name} {fam.kind}")
-            for name, labels, value in fam.samples():
+            for name, labels, value, *rest in fam.samples():
                 if labels:
                     lab = ",".join(
                         f'{k}="{_escape_label(v)}"' for k, v in labels)
-                    out.append(f"{name}{{{lab}}} {_fmt_number(value)}")
+                    line = f"{name}{{{lab}}} {_fmt_number(value)}"
                 else:
-                    out.append(f"{name} {_fmt_number(value)}")
+                    line = f"{name} {_fmt_number(value)}"
+                if rest and rest[0] is not None:
+                    # OpenMetrics exemplar: the bucket's most recent
+                    # trace id + observed value (waterfall stage
+                    # histograms; parsers that predate exemplars strip
+                    # from " # " — doctor's does)
+                    ex_id, ex_v = rest[0]
+                    line += (f' # {{trace_id="{_escape_label(ex_id)}"}} '
+                             f"{_fmt_number(ex_v)}")
+                out.append(line)
         dead = []
         for ref in collectors:
             fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
@@ -445,24 +475,49 @@ EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _TRACES_LIMIT_DEFAULT = 64
 _TRACES_LIMIT_MAX = 1024
 
+#: every /debug/* surface this module serves for the daemons. The
+#: tier-1 debug-surface lint (tests/test_timing_lint.py) asserts each
+#: path answers on all three daemons — a new debug endpoint added here
+#: is automatically everywhere, and one added anywhere else fails the
+#: lint until it is shared.
+DEBUG_PATHS: Tuple[str, ...] = (
+    "/debug/device.json", "/debug/slow.json", "/debug/profile")
+
 
 def handle_route(method: str, path: str,
                  query: Optional[Dict[str, str]] = None):
-    """Serve ``GET /metrics`` / ``GET /traces.json`` /
-    ``GET /debug/device.json`` for any daemon's route handler; returns
-    None when the request is not a telemetry route (the handler
-    continues with its own table). Unauthenticated by design, like
-    ``/healthz`` — the payload is operational counters, not data.
+    """Serve ``GET /metrics`` / ``GET /traces.json`` / the ``/debug/*``
+    surfaces (``device.json``, ``slow.json``, ``profile``) for any
+    daemon's route handler; returns None when the request is not a
+    telemetry route (the handler continues with its own table).
+    Unauthenticated by design, like ``/healthz`` — the payload is
+    operational counters, not data.
 
     /traces.json accepts ``?limit=N`` (bounds-checked: clamped to
     [1, 1024], default 64) and ``?trace_id=<id>`` so `pio doctor` and
     dashboards can do cheap targeted reads instead of dumping the whole
     ring buffer."""
+    if path == "/debug/profile":
+        # the one non-GET telemetry route: POST starts a bounded
+        # on-demand jax.profiler capture, GET lists artifacts
+        from predictionio_tpu.common import profiling
+        return profiling.handle_route(method, query)
     if method != "GET":
         return None
     if path == "/metrics":
         return 200, REGISTRY.exposition(), {
             "Content-Type": EXPOSITION_CONTENT_TYPE}
+    if path == "/debug/slow.json":
+        from predictionio_tpu.common import waterfall
+        limit = _TRACES_LIMIT_DEFAULT
+        if query and query.get("limit"):
+            try:
+                limit = max(1, min(int(query["limit"]),
+                                   _TRACES_LIMIT_MAX))
+            except ValueError:
+                return 400, {"message": "limit must be an integer, got "
+                             f"{query['limit']!r}"}
+        return 200, waterfall.slow_snapshot(limit=limit)
     if path == "/traces.json":
         from predictionio_tpu.common import tracing
         limit = _TRACES_LIMIT_DEFAULT

@@ -99,8 +99,11 @@ class EventAPI:
         # device observability on this daemon's /metrics and
         # /debug/device.json too (the event server rarely compiles, but
         # the operator's scrape surface is uniform; idempotent)
-        from predictionio_tpu.common import devicewatch
+        from predictionio_tpu.common import devicewatch, slo
         devicewatch.install()
+        # SLO burn-rate gauges (env-default targets; a query server in
+        # the same process installs its configured targets over these)
+        slo.install()
 
     # ------------------------------------------------------------------ auth
     def _authenticate(self, query: Dict[str, str],

@@ -120,8 +120,11 @@ class StorageRPCAPI:
         self._dedup_lock = threading.Lock()
         # uniform device-observability surface (/metrics gauges +
         # /debug/device.json) on the storage daemon as well (idempotent)
-        from predictionio_tpu.common import devicewatch
+        from predictionio_tpu.common import devicewatch, slo
         devicewatch.install()
+        # SLO burn-rate gauges (env-default targets; a query server in
+        # the same process installs its configured targets over these)
+        slo.install()
 
     # -- per-DAO method tables, each entry: args-dict -> JSON-able ----------
     def _events(self, m: str, a: Dict[str, Any]):

@@ -143,7 +143,7 @@ def observed_flush_sizes() -> Dict[int, int]:
     if fam is None:
         return {}
     out: Dict[int, int] = {}
-    for _name, labels, value in fam.samples():
+    for _name, labels, value, *_rest in fam.samples():
         size = dict(labels).get("size")
         try:
             n = int(size)

@@ -51,7 +51,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from predictionio_tpu.common import devicewatch, telemetry, tracing
+from predictionio_tpu.common import devicewatch, telemetry, tracing, waterfall
 from predictionio_tpu.serving import protocol
 from predictionio_tpu.serving.protocol import bucket_for, pad_buckets
 
@@ -75,10 +75,12 @@ class ServerSaturated(Exception):
 
 
 class _Pending:
-    __slots__ = ("item", "t_enq", "done", "result", "error", "trace")
+    __slots__ = ("item", "t_enq", "done", "result", "error", "trace",
+                 "rec")
 
     def __init__(self, item: Any, t_enq: float,
-                 trace: Optional["tracing.TraceContext"] = None):
+                 trace: Optional["tracing.TraceContext"] = None,
+                 rec: Optional["waterfall.RequestRecord"] = None):
         self.item = item
         self.t_enq = t_enq
         self.done = threading.Event()
@@ -88,6 +90,10 @@ class _Pending:
         #: records this item's admission span under it and parents the
         #: batch's flush span on the head item's
         self.trace = trace
+        #: the submitting request's waterfall record (common/waterfall):
+        #: the worker credits this item's admission wait to it and the
+        #: flush-level stages record into every record of the batch
+        self.rec = rec
 
 
 class MicroBatcher:
@@ -161,13 +167,15 @@ class MicroBatcher:
         exception the flush callback raised for this item's batch.
         """
         trace = tracing.current()
+        rec = waterfall.current()
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             if len(self._q) >= self.max_queue:
                 self._m_rejected.inc()
                 raise ServerSaturated(self._retry_after_locked())
-            pending = _Pending(item, time.monotonic(), trace=trace)
+            pending = _Pending(item, time.monotonic(), trace=trace,
+                               rec=rec)
             self._q.append(pending)
             self._m_depth.set(len(self._q))
             self._cond.notify_all()
@@ -222,6 +230,18 @@ class MicroBatcher:
                         head_ctx = p.trace
                     tracing.record_span("admission", p.trace,
                                         now - p.t_enq, service=self.name)
+                if p.rec is not None:
+                    # waterfall: each item's own queue wait (off-thread,
+                    # so explicit-duration like the span above)
+                    waterfall.observe_stage("admission", now - p.t_enq,
+                                            (p.rec,))
+            recs = [p.rec for p in batch if p.rec is not None]
+            if recs:
+                # the bucket this flush pads onto — the detail that turns
+                # "p99 is 8 ms" into "it's pad-to-bucket on bucket=64"
+                for r in recs:
+                    r.note("bucket", bucket)
+                    r.note("batchSize", len(batch))
             t0 = time.monotonic()
             try:
                 # recompile watchdog (common/devicewatch.py): any XLA
@@ -239,8 +259,13 @@ class MicroBatcher:
                     with protocol.flush_buckets(self.buckets):
                         with tracing.activate(head_ctx):
                             with tracing.span("flush", service=self.name):
-                                results = self._flush_fn(
-                                    [p.item for p in batch])
+                                # flush-level waterfall stages
+                                # (supplement/dispatch/pad/execute/merge
+                                # inside the flush callback) record into
+                                # every sampled rider of this batch
+                                with waterfall.activate(recs):
+                                    results = self._flush_fn(
+                                        [p.item for p in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"flush returned {len(results)} results for a "

@@ -74,6 +74,11 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     ("time_to_ready_s", "down", False),
     ("aot_prebuild_s", "down", False),
     ("first_query_compile_s", "down", False),
+    # diagnosis era (common/waterfall.py): the stage-sampling path's p99
+    # tax vs sampling off — trended here, hard-gated at <= 5% by the
+    # bench's own waterfall leg under BENCH_STRICT_EXTRAS=1
+    ("waterfall_overhead_p99_pct", "down", False),
+    ("waterfall_on_p99_ms", "down", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round

@@ -213,9 +213,18 @@ def cmd_deploy(args) -> int:
         drain_grace_s=args.drain_grace_s,
         aot=args.aot,
         aot_threads=args.aot_threads,
+        slo_availability=args.slo_availability,
+        slo_latency_ms=args.slo_latency_ms,
     )
     if args.compile_cache:
         os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
+    if args.waterfall:
+        # per-request latency waterfalls + /debug/slow.json
+        # (common/waterfall.py)
+        os.environ["PIO_WATERFALL"] = "1"
+    if args.profile_dir:
+        # where POST /debug/profile captures land (common/profiling.py)
+        os.environ["PIO_PROFILE_DIR"] = args.profile_dir
     # undeploy a previous server on the same port (CreateServer.scala:260-294)
     if undeploy(args.ip, args.port):
         _info(f"Undeployed previous server at {args.ip}:{args.port}.")
@@ -224,6 +233,18 @@ def cmd_deploy(args) -> int:
           f"http://{args.ip}:{args.port}.")
     serve(api, host=args.ip, port=args.port)
     return 0
+
+
+def cmd_profile(args) -> int:
+    """Bounded on-demand device-profile capture from a LIVE daemon
+    (tools/profile.py -> POST /debug/profile): no restart, hard max
+    duration, single concurrent capture; the artifact lands on the
+    server's filesystem in the same xprof layout as `pio train
+    --profile DIR`. Exit 0 non-empty artifact / 1 failed / 2 dead."""
+    from predictionio_tpu.tools.profile import run_profile
+    url = args.url or f"http://{args.ip}:{args.port}"
+    return run_profile(url, ms=args.ms, out_dir=args.out or None,
+                       timeout=args.timeout)
 
 
 def cmd_doctor(args) -> int:
@@ -600,6 +621,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="persistent XLA compile-cache directory to "
                          "pre-seed from the model's exported cache "
                          "artifact (sets PIO_COMPILE_CACHE_DIR)")
+    sp.add_argument("--waterfall", action="store_true",
+                    help="sample per-request latency waterfalls "
+                         "(pio_serve_stage_seconds + /debug/slow.json; "
+                         "sets PIO_WATERFALL=1)")
+    sp.add_argument("--profile-dir", default="",
+                    help="directory for POST /debug/profile capture "
+                         "artifacts (sets PIO_PROFILE_DIR)")
+    sp.add_argument("--slo-availability", type=float, default=None,
+                    help="availability SLO target, e.g. 0.999 "
+                         "(default PIO_SLO_AVAILABILITY or 0.999)")
+    sp.add_argument("--slo-latency-ms", type=float, default=None,
+                    help="latency SLO threshold in ms, e.g. 25 "
+                         "(default PIO_SLO_LATENCY_MS or 25)")
     telemetry_flags(sp)
 
     sp = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -617,6 +651,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=8000)
     sp.add_argument("--timeout", type=float, default=5.0,
                     help="per-scrape timeout in seconds")
+
+    sp = sub.add_parser(
+        "profile",
+        help="capture a bounded device profile from a running daemon "
+             "(POST /debug/profile; artifact in xprof layout on the "
+             "server; exit 0 non-empty / 1 failed / 2 unreachable)")
+    sp.add_argument("url", nargs="?", default="",
+                    help="daemon base URL (default http://<ip>:<port>)")
+    sp.add_argument("--ip", default="localhost")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--ms", type=int, default=2000,
+                    help="capture length in ms (server clamps to its "
+                         "PIO_PROFILE_MAX_MS, default 10000)")
+    sp.add_argument("-o", "--out", default="",
+                    help="server-side directory for the artifact "
+                         "(default: the server's PIO_PROFILE_DIR)")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-request timeout in seconds")
 
     sp = sub.add_parser("run", help="run an arbitrary entry point")
     sp.add_argument("main_class")
@@ -723,6 +775,7 @@ _DISPATCH = {
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
     "doctor": cmd_doctor,
+    "profile": cmd_profile,
     "run": cmd_run,
     "eventserver": cmd_eventserver,
     "dashboard": cmd_dashboard,

@@ -1,8 +1,11 @@
 """`pio doctor` — one-screen operator verdict for a running daemon.
 
 Scrapes a daemon's observability surface (`/healthz`, `/readyz`,
-`/metrics`, `/traces.json?limit=8`, `/debug/device.json`) and renders
-every check on one screen with a green/warn/red state:
+`/metrics`, `/traces.json?limit=8`, `/debug/device.json`,
+`/debug/slow.json?limit=3`) and renders every check on one screen with
+a green/warn/red state — including the SLO burn-rate verdict
+(common/slo.py: RED when the fast window is alight) and the latency
+waterfall's slowest sampled request:
 
     $ pio doctor http://localhost:8000
     pio doctor — http://localhost:8000 (QueryAPI)
@@ -43,8 +46,19 @@ OK, WARN, RED, NA = "ok", "WARN", "RED", "--"
 _HBM_WARN = 0.80
 _HBM_RED = 0.95
 
+#: SLO burn-rate thresholds (common/slo.py, SRE Workbook ch. 5):
+#: fast-window burn at page level is RED, slow-window at ticket level
+#: is WARN
+_FAST_BURN_RED = 14.4
+_SLOW_BURN_WARN = 6.0
+
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+
+#: OpenMetrics exemplar suffix (waterfall stage histograms carry the
+#: most recent trace id per bucket): stripped before sample parsing so
+#: an exemplar-bearing line still yields its (name, labels, value)
+_EXEMPLAR_RE = re.compile(r'\s+#\s+\{.*$')
 
 
 def parse_metrics(text: str) -> Dict[str, List[Tuple[str, float]]]:
@@ -55,7 +69,7 @@ def parse_metrics(text: str) -> Dict[str, List[Tuple[str, float]]]:
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
-        m = _SAMPLE_RE.match(line)
+        m = _SAMPLE_RE.match(_EXEMPLAR_RE.sub("", line))
         if not m:
             continue
         name, labels, value = m.groups()
@@ -131,7 +145,8 @@ def scrape(base_url: str, timeout: float = 5.0) -> Dict[str, Any]:
     for key, path in (("healthz", "/healthz"), ("readyz", "/readyz"),
                       ("metrics", "/metrics"),
                       ("traces", "/traces.json?limit=8"),
-                      ("device", "/debug/device.json")):
+                      ("device", "/debug/device.json"),
+                      ("slow", "/debug/slow.json?limit=3")):
         status, body = _get(base_url, path, timeout)
         out[key] = {"status": status, "body": body}
     return out
@@ -180,6 +195,15 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
     samples = parse_metrics(scraped["metrics"]["body"]
                             if scraped["metrics"]["status"] == 200 else "")
 
+    # a {"telemetry": false} device payload means PIO_TELEMETRY is
+    # simply unset — NOT that the daemon lost its device stats; the
+    # device-dependent checks below print the opt-in hint instead of
+    # the misleading "missing" line
+    device = _json_body(scraped["device"]) or {}
+    telemetry_off = device.get("telemetry") is False
+    _OPT_IN = ("telemetry off — run with --telemetry (PIO_TELEMETRY=1) "
+               "to record {}")
+
     # queue ------------------------------------------------------------
     depth = metric_max(samples, "pio_batcher_queue_depth")
     rejected = metric_sum(samples, "pio_batcher_rejected_total")
@@ -196,12 +220,59 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
     count = metric_sum(samples, "pio_serve_seconds_count")
     if p99 is None:
         checks.append(("serving", NA,
-                       "no pio_serve_seconds yet (PIO_TELEMETRY off or "
-                       "no queries)"))
+                       _OPT_IN.format("serve latency") if telemetry_off
+                       else "no pio_serve_seconds yet (no queries served "
+                            "so far)"))
     else:
         ms = "inf" if p99 == float("inf") else f"{p99 * 1e3:g}"
         checks.append(("serving", OK,
                        f"p99 <= {ms} ms over {int(count or 0)} queries"))
+
+    # SLO burn (common/slo.py; Google-SRE multiwindow burn rates) ------
+    burns: Dict[Tuple[str, str], float] = {}
+    for labels, v in samples.get("pio_slo_burn_rate", []):
+        slo_m = re.search(r'slo="([^"]+)"', labels)
+        win_m = re.search(r'window="([^"]+)"', labels)
+        if slo_m and win_m:
+            burns[(slo_m.group(1), win_m.group(1))] = v
+    if not burns:
+        checks.append(("slo", NA,
+                       _OPT_IN.format("SLO burn rates") if telemetry_off
+                       else "no pio_slo_burn_rate series (old daemon?)"))
+    else:
+        # the SRE-Workbook multiwindow page condition: BOTH the fast
+        # and the long window over the page threshold (the long window
+        # keeps a lifetime blip from paging, the short one makes the
+        # alert reset fast once the burn stops)
+        fast_hot = {s for (s, w), v in burns.items()
+                    if w == "fast" and v >= _FAST_BURN_RED
+                    and burns.get((s, "slow"), v) >= _FAST_BURN_RED}
+        slow_hot = {s for (s, w), v in burns.items()
+                    if w == "slow" and v >= _SLOW_BURN_WARN}
+        budgets = {}
+        for labels, v in samples.get("pio_slo_error_budget_remaining", []):
+            m = re.search(r'slo="([^"]+)"', labels)
+            if m:
+                budgets[m.group(1)] = v
+        budget_txt = ", ".join(
+            f"{s} budget {v * 100:.1f}%"
+            for s, v in sorted(budgets.items())) or "no budget series"
+        if fast_hot:
+            detail = "; ".join(
+                f"{s} burning {burns[(s, 'fast')]:.1f}x over the fast "
+                "window" for s in sorted(fast_hot))
+            checks.append(("slo", RED,
+                           f"error budget ALIGHT: {detail} "
+                           f"(>= {_FAST_BURN_RED:g}x pages; {budget_txt})"))
+        elif slow_hot:
+            detail = "; ".join(
+                f"{s} burning {burns[(s, 'slow')]:.1f}x over the slow "
+                "window" for s in sorted(slow_hot))
+            checks.append(("slo", WARN, f"{detail} (>= "
+                           f"{_SLOW_BURN_WARN:g}x is ticket-worthy; "
+                           f"{budget_txt})"))
+        else:
+            checks.append(("slo", OK, f"within budget ({budget_txt})"))
 
     # circuit breakers -------------------------------------------------
     open_eps = [labels for labels, v in
@@ -226,7 +297,6 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
     # post-warmup recompiles (the devicewatch alarm) -------------------
     recompiles = metric_sum(samples,
                             "pio_xla_post_warmup_recompiles_total") or 0
-    device = _json_body(scraped["device"]) or {}
     watchdog = device.get("watchdog") or {}
     if recompiles > 0:
         sigs = ", ".join(
@@ -287,9 +357,14 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
     in_use = metric_sum(samples, "pio_hbm_bytes_in_use")
     limit = metric_sum(samples, "pio_hbm_bytes_limit")
     if in_use is None or not limit:
+        # two very different "no data" cases: telemetry simply not
+        # opted into, vs a platform that genuinely reports no memory
+        # stats (CPU; KNOWN_ISSUES #8)
         checks.append(("hbm", NA,
-                       "no device memory stats (CPU / unsupported — "
-                       "KNOWN_ISSUES #8)"))
+                       _OPT_IN.format("device memory stats")
+                       if telemetry_off
+                       else "no device memory stats (CPU / unsupported — "
+                            "KNOWN_ISSUES #8)"))
     else:
         frac = in_use / limit
         state = RED if frac >= _HBM_RED else (
@@ -306,6 +381,29 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
         checks.append(("traces", OK,
                        f"{tr.get('spanCount', 0)} spans buffered "
                        f"(originate={'on' if tr.get('originate') else 'off'})"))
+
+    # latency waterfall / slow ring (common/waterfall.py) --------------
+    slow = _json_body(scraped.get("slow", {}))
+    if slow is None:
+        checks.append(("waterfall", NA, "no /debug/slow.json"))
+    elif not slow.get("enabled"):
+        checks.append(("waterfall", NA,
+                       "sampling off — set PIO_WATERFALL=1 for "
+                       "per-request stage breakdowns"))
+    else:
+        reqs = slow.get("requests") or []
+        if reqs:
+            top = reqs[0]
+            top_stage = max((top.get("stages") or {"?": 0}).items(),
+                            key=lambda kv: kv[1])
+            checks.append(("waterfall", OK,
+                           f"slowest sampled request {top.get('totalMs')}"
+                           f" ms (mostly {top_stage[0]}, "
+                           f"{top_stage[1]:g} ms; trace "
+                           f"{top.get('traceId')})"))
+        else:
+            checks.append(("waterfall", OK,
+                           "sampling on, no requests recorded yet"))
     return checks
 
 

@@ -152,10 +152,14 @@ def run_train(
         profile_dir = getattr(ctx.workflow_params, "profile_dir", None)
         if profile_dir:
             # JAX profiler trace — the Spark-UI replacement (SURVEY.md §5);
-            # view with tensorboard or xprof
-            import jax
+            # view with tensorboard or xprof. Routed through
+            # common/profiling.py so the train artifact shares one
+            # format (capture.json + xprof layout) and one
+            # single-capture guard with the daemons' on-demand
+            # POST /debug/profile captures.
+            from predictionio_tpu.common import profiling
 
-            with jax.profiler.trace(profile_dir):
+            with profiling.trace(profile_dir, label="train"):
                 models = engine.train(ctx, engine_params)
         else:
             models = engine.train(ctx, engine_params)

@@ -29,7 +29,9 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
-from predictionio_tpu.common import devicewatch, resilience, telemetry, tracing
+from predictionio_tpu.common import (
+    devicewatch, resilience, slo, telemetry, tracing, waterfall,
+)
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
 from predictionio_tpu.data.event import (
@@ -91,6 +93,15 @@ class ServerConfig:
     aot: str = "auto"
     #: prebuild thread-pool width (0 = PIO_AOT_THREADS or default 4)
     aot_threads: int = 0
+    #: SLO targets (common/slo.py): availability = fraction of non-5xx
+    #: responses, latency = fraction of serves at/under the threshold.
+    #: None defers to PIO_SLO_AVAILABILITY / PIO_SLO_LATENCY_MS /
+    #: PIO_SLO_LATENCY_TARGET (defaults 0.999 / 25 ms / 0.99); the
+    #: engine exports budget + burn-rate gauges at scrape time and
+    #: feeds the `pio doctor` SLO line.
+    slo_availability: Optional[float] = None
+    slo_latency_ms: Optional[float] = None
+    slo_latency_target: Optional[float] = None
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -202,6 +213,12 @@ class QueryAPI:
         # device observability: compile watchdog + HBM/live-array gauges
         # on this daemon's /metrics and /debug/device.json (idempotent)
         devicewatch.install()
+        # SLO engine: this server's configured targets win over any
+        # default install from a sibling daemon in the process
+        slo.install(slo.SLOConfig.from_env(
+            availability=self.config.slo_availability,
+            latency_ms=self.config.slo_latency_ms,
+            latency_target=self.config.slo_latency_target))
         #: wall-clock from construction to servable (model loaded, AOT
         #: prebuild done) — the metric the <10 s warm-replica gate reads
         self.time_to_ready_s: Optional[float] = None
@@ -351,15 +368,20 @@ class QueryAPI:
             # lookups run inside predict_batch where per-query attribution
             # is not visible from here; KNOWN_ISSUES documents this)
             resilience.reset_degraded()
-            supplemented = [serving.supplement(q) for q in queries]
+            with waterfall.stage("supplement"):
+                supplemented = [serving.supplement(q) for q in queries]
             # the batched device dispatch (ends in a real host transfer —
             # jax.device_get of the top-k — per KNOWN_ISSUES #3, so the
-            # span duration is honest on tunneled platforms)
+            # span duration is honest on tunneled platforms). Waterfall:
+            # `dispatch` is the whole predict_batch; the algorithm
+            # refines it with nested pad/execute stages.
             with tracing.span("dispatch", service="query-server"):
-                per_algo = [protocol.predict_batch(a, m, supplemented)
-                            for a, m in zip(algorithms, models)]
-            served = [serving.serve(q, [col[j] for col in per_algo])
-                      for j, q in enumerate(queries)]
+                with waterfall.stage("dispatch"):
+                    per_algo = [protocol.predict_batch(a, m, supplemented)
+                                for a, m in zip(algorithms, models)]
+            with waterfall.stage("merge"):
+                served = [serving.serve(q, [col[j] for col in per_algo])
+                          for j, q in enumerate(queries)]
             degraded = bool(resilience.pop_degraded())
             if degraded:
                 # ONE tainted flush, up to len(queries) flagged responses
@@ -543,11 +565,17 @@ class QueryAPI:
                 getattr(algorithms[0], "query_class", None), body)
         except (ValueError, UnicodeDecodeError) as e:
             return 400, {"message": str(e)}
+        # latency waterfall (common/waterfall.py, PIO_WATERFALL=1): this
+        # request's stage breakdown — rec is None when sampling is off
+        # and every waterfall call below is a cheap no-op
+        rec = waterfall.begin("batched" if batcher is not None
+                              else "inline")
         if batcher is not None:
             # micro-batched path: block until this query's coalesced batch
             # is served; concurrent requests share one device dispatch
             try:
-                prediction, degraded = batcher.submit(query)
+                with waterfall.activate((rec,)):
+                    prediction, degraded = batcher.submit(query)
             except ServerSaturated as e:
                 return 503, {"message": (
                     "serving queue is saturated (admission control); "
@@ -567,13 +595,19 @@ class QueryAPI:
             resilience.reset_degraded()
             with devicewatch.serving_region("serve_inline",
                                             signature="inline"):
-                supplemented = serving.supplement(query)
-                predictions = [a.predict(m, supplemented)
-                               for a, m in zip(algorithms, models)]
-                prediction = serving.serve(query, predictions)
+                with waterfall.activate((rec,)):
+                    with waterfall.stage("supplement"):
+                        supplemented = serving.supplement(query)
+                    with waterfall.stage("dispatch"):
+                        predictions = [a.predict(m, supplemented)
+                                       for a, m in zip(algorithms, models)]
+                    with waterfall.stage("merge"):
+                        prediction = serving.serve(query, predictions)
             degraded = bool(resilience.pop_degraded())
             devicewatch.note_serving_flush()
-        result = json_extractor.to_json_obj(prediction)
+        with waterfall.activate((rec,)):
+            with waterfall.stage("serialize"):
+                result = json_extractor.to_json_obj(prediction)
         if degraded:
             # per-RESPONSE count: with batching on this over-counts (the
             # whole flush is tainted), hence "upper bound" in the metric
@@ -608,6 +642,7 @@ class QueryAPI:
                          "or /reload a healthy instance"}
 
         dt = time.perf_counter() - t0
+        waterfall.end(rec)   # close the breakdown; offer to /debug/slow.json
         if telemetry.on():
             # end-to-end serve latency (parse -> batched/inline predict ->
             # serialize); the predict path ends in a host transfer, so

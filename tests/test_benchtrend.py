@@ -135,6 +135,76 @@ def test_gate_current_for_bench_wiring(tmp_path):
     assert failures and "value" in failures[0]
 
 
+# ---------------------------------------------------------------------------
+# ABSOLUTE_GATES: the warm-cache-only availability ceilings
+# ---------------------------------------------------------------------------
+
+_WARM = {"compile_cache": {"before": {"entries": 100, "bytes": 1}}}
+_COLD = {"compile_cache": {"before": {"entries": 0, "bytes": 0}}}
+
+
+def test_absolute_gate_fires_on_first_ever_warm_round(tmp_path):
+    """The ceiling needs NO prior round: the very first warm-cache round
+    is already accountable for the < 10 s warm-replica promise."""
+    p = _write_round(tmp_path, 1, 1.0,
+                     {"time_to_ready_s": 42.0, **_WARM})
+    rounds, _ = benchtrend.load_rounds([p])
+    failures = benchtrend.gate(rounds)
+    assert len(failures) == 1
+    assert "time_to_ready_s" in failures[0] and "ceiling" in failures[0]
+    # and a first warm round UNDER the ceiling gates nothing
+    ok = _write_round(tmp_path, 1, 1.0,
+                      {"time_to_ready_s": 3.0, **_WARM})
+    rounds, _ = benchtrend.load_rounds([ok])
+    assert benchtrend.gate(rounds) == []
+
+
+def test_absolute_gate_skips_cold_and_unknown_cache_rounds(tmp_path):
+    # cold cache: full compiles are legitimate, not an availability breach
+    cold = _write_round(tmp_path, 1, 1.0,
+                        {"time_to_ready_s": 400.0, **_COLD})
+    rounds, _ = benchtrend.load_rounds([cold])
+    assert benchtrend.gate(rounds) == []
+    # no compile_cache detail at all (pre-r05 era): unknown, never gated
+    unknown = _write_round(tmp_path, 1, 1.0, {"time_to_ready_s": 400.0})
+    rounds, _ = benchtrend.load_rounds([unknown])
+    assert benchtrend.gate(rounds) == []
+
+
+def test_absolute_gate_mixed_history_judges_newest_round_only(tmp_path):
+    """Mixed warm/cold history: only the NEWEST round's own cache state
+    decides whether its ceiling applies — a breaching warm round fails
+    even after a cold round, and a cold newest round passes even after
+    warm priors."""
+    paths = [
+        _write_round(tmp_path, 1, 1.0, {"time_to_ready_s": 3.0, **_WARM}),
+        _write_round(tmp_path, 2, 1.0,
+                     {"time_to_ready_s": 400.0, **_COLD}),
+        _write_round(tmp_path, 3, 1.0, {"time_to_ready_s": 12.0, **_WARM}),
+    ]
+    rounds, _ = benchtrend.load_rounds(paths)
+    failures = benchtrend.gate(rounds)
+    assert any("time_to_ready_s" in f and "ceiling" in f
+               for f in failures)
+    # newest cold round after warm priors: the ceiling stands down
+    paths.append(_write_round(tmp_path, 4, 1.0,
+                              {"time_to_ready_s": 400.0, **_COLD}))
+    rounds, _ = benchtrend.load_rounds(paths)
+    assert not any("ceiling" in f for f in benchtrend.gate(rounds))
+
+
+def test_absolute_gate_breach_exits_nonzero_via_cli(tmp_path, capsys):
+    paths = [
+        _write_round(tmp_path, 1, 1.0, {"time_to_ready_s": 3.0, **_WARM}),
+        _write_round(tmp_path, 2, 1.0, {"time_to_ready_s": 30.0, **_WARM}),
+    ]
+    assert benchtrend.main(["--gate", *paths]) == 1
+    err = capsys.readouterr().err
+    assert "BENCHTREND GATE FAILED" in err and "time_to_ready_s" in err
+    # report-only mode still prints the table and exits 0
+    assert benchtrend.main(paths) == 0
+
+
 @pytest.mark.parametrize("gate_flag", [False, True])
 def test_real_repo_history_renders_and_passes(gate_flag, capsys):
     """The actual 5-round BENCH_r*.json series in the repo: the table

@@ -49,17 +49,23 @@ def _clear_counter_family(name):
 
 @pytest.fixture(autouse=True)
 def _clean():
-    """Watchdog state and telemetry overrides never leak across tests
-    (registry families persist by design — assert on deltas)."""
+    """Watchdog state, telemetry overrides, and the SLO engine never
+    leak across tests (registry families persist by design — assert on
+    deltas). A fresh SLO engine per test keeps the doctor's burn-rate
+    line deterministic: its first scrape forms the baseline, so no
+    earlier test's slow serves read as an in-window burn here."""
+    from predictionio_tpu.common import slo
     telemetry.set_enabled(None)
     tracing.set_enabled(None)
     devicewatch.reset_watchdog()
     CircuitBreaker.reset_registry()
+    slo.reset()
     yield
     telemetry.set_enabled(None)
     tracing.set_enabled(None)
     devicewatch.reset_watchdog()
     CircuitBreaker.reset_registry()
+    slo.reset()
 
 
 def _train_engine(storage, n_items=9, rank=5):

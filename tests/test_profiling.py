@@ -1,0 +1,176 @@
+"""On-demand device-profiling tests (common/profiling.py + `pio
+profile`).
+
+Acceptance: `pio profile` against a live in-process daemon produces a
+non-empty trace artifact; captures are bounded (hard max duration),
+single-concurrent (409 while one runs), and listed by
+`GET /debug/profile` on every daemon.
+"""
+
+import io
+import json
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from predictionio_tpu.common import profiling
+from predictionio_tpu.data.api import EventAPI
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.tools.profile import run_profile
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_PROFILE_DIR", str(tmp_path / "profiles"))
+    profiling.reset()
+    yield
+    # never leave a dangling jax trace behind for the next test
+    deadline = time.perf_counter() + 15.0
+    while profiling.list_captures()["active"] is not None:
+        if time.perf_counter() > deadline:
+            pytest.fail("profiling capture never finished")
+        time.sleep(0.05)
+    profiling.reset()
+
+
+def _wait_done(capture_id, timeout=15.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        c = profiling.get_capture(capture_id)
+        if c is not None and c.get("state") != "running":
+            return c
+        time.sleep(0.05)
+    pytest.fail(f"capture {capture_id} never completed")
+
+
+def test_capture_is_bounded_single_and_listed(monkeypatch):
+    monkeypatch.setenv("PIO_PROFILE_MAX_MS", "300")
+    entry = profiling.start_capture(ms=60_000)   # clamped to 300
+    assert entry["requestedMs"] == 300
+    # single concurrent capture: a second start is refused
+    with pytest.raises(profiling.CaptureBusy):
+        profiling.start_capture(ms=100)
+    # some device work lands inside the capture window
+    float(jnp.ones((32, 32)).sum())
+    done = _wait_done(entry["id"])
+    assert done["state"] == "done"
+    assert done["files"], "capture produced no artifact files"
+    assert done["bytes"] > 0
+    import os
+    assert os.path.exists(os.path.join(done["dir"], "capture.json"))
+    # the hard max really bounded it (60 s requested, ~0.3 s ran)
+    assert done["durationMs"] < 10_000
+    listing = profiling.list_captures()
+    assert listing["active"] is None
+    assert listing["captures"][0]["id"] == entry["id"]
+    # the slot is free again
+    e2 = profiling.start_capture(ms=50)
+    _wait_done(e2["id"])
+
+
+def test_capture_rejects_bad_ms():
+    with pytest.raises(ValueError):
+        profiling.start_capture(ms=0)
+
+
+def test_debug_profile_route_get_and_post(memory_storage):
+    api = EventAPI(storage=memory_storage)
+    st, listing = api.handle("GET", "/debug/profile")
+    assert st == 200 and "captures" in listing and "maxMs" in listing
+    st, payload = api.handle("POST", "/debug/profile",
+                             query={"ms": "bogus"})
+    assert st == 400
+    st, payload = api.handle("POST", "/debug/profile",
+                             query={"ms": "100"})
+    assert st == 202
+    cap = payload["capture"]
+    assert cap["state"] == "running"
+    # second POST while running: 409, not a corrupted first capture
+    st, busy = api.handle("POST", "/debug/profile", query={"ms": "100"})
+    assert st == 409
+    done = _wait_done(cap["id"])
+    assert done["state"] in ("done", "empty")
+
+
+def test_pio_profile_cli_against_live_daemon(memory_storage, tmp_path):
+    """The acceptance path: `pio profile <url>` against a live
+    in-process daemon yields a non-empty trace artifact."""
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api, "127.0.0.1", 0)
+    try:
+        # concurrent device work so the profiler window sees dispatches
+        float(jnp.ones((64, 64)).sum())
+        buf = io.StringIO()
+        rc = run_profile(f"http://127.0.0.1:{port}", ms=400,
+                         out_dir=str(tmp_path / "cli-capture"), out=buf)
+        text = buf.getvalue()
+        assert rc == 0, text
+        assert "capture done" in text
+        assert "file(s)" in text
+        # artifact landed under the requested server-side dir
+        listing = profiling.list_captures()
+        assert listing["captures"][0]["dir"].startswith(
+            str(tmp_path / "cli-capture"))
+        assert listing["captures"][0]["files"]
+    finally:
+        server.shutdown()
+
+
+def test_pio_profile_cli_unreachable_exits_2():
+    buf = io.StringIO()
+    assert run_profile("http://127.0.0.1:1", ms=100, out=buf) == 2
+    assert "unreachable" in buf.getvalue()
+
+
+def test_cli_profile_subcommand_wiring(memory_storage, tmp_path):
+    from predictionio_tpu.tools.cli import main as cli_main
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api, "127.0.0.1", 0)
+    try:
+        float(jnp.ones((64, 64)).sum())
+        rc = cli_main(["profile", f"http://127.0.0.1:{port}",
+                       "--ms", "300",
+                       "-o", str(tmp_path / "sub-capture")])
+        assert rc == 0
+    finally:
+        server.shutdown()
+
+
+def test_train_profile_shares_capture_format(memory_storage, tmp_path):
+    """profiling.trace (the `pio train --profile DIR` path) writes the
+    same capture.json + xprof layout and shares the single-capture
+    guard."""
+    out = tmp_path / "train-prof"
+    with profiling.trace(str(out), label="train"):
+        with pytest.raises(profiling.CaptureBusy):
+            profiling.start_capture(ms=100)
+        float(jnp.ones((32, 32)).sum())
+    meta = json.loads((out / "capture.json").read_text())
+    assert meta["label"] == "train" and meta["state"] == "done"
+    assert meta["files"], "train capture listed no artifact files"
+    listing = profiling.list_captures()
+    assert listing["captures"][0]["label"] == "train"
+    assert listing["captures"][0]["files"]
+
+
+def test_profile_over_http_query_params(memory_storage):
+    """End-to-end over real HTTP: POST with query params, poll GET."""
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        req = urllib.request.Request(f"{base}/debug/profile?ms=150",
+                                     data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 202
+            cap = json.loads(r.read().decode())["capture"]
+        float(jnp.ones((32, 32)).sum())
+        _wait_done(cap["id"])
+        with urllib.request.urlopen(f"{base}/debug/profile",
+                                    timeout=10) as r:
+            listing = json.loads(r.read().decode())
+        assert any(c["id"] == cap["id"] for c in listing["captures"])
+    finally:
+        server.shutdown()

@@ -1,0 +1,254 @@
+"""SLO engine tests (common/slo.py + the `pio doctor` SLO line).
+
+Burn-rate math over synthetic registry counters, the scrape-time
+collector's wire parity (no series until PIO_TELEMETRY=1), ServerConfig
+target plumbing, and the doctor verdict (RED when the fast window is
+alight, WARN on slow burn, NA with the opt-in hint when telemetry is
+off).
+"""
+
+import json
+
+import pytest
+
+from predictionio_tpu.common import slo, telemetry
+from predictionio_tpu.tools import doctor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.set_enabled(None)
+    slo.reset()
+    yield
+    telemetry.set_enabled(None)
+    slo.reset()
+
+
+@pytest.fixture()
+def fresh_registry(monkeypatch):
+    """An empty process registry so the burn math sees exactly the
+    counters this test writes (the real registry is additive across
+    the whole test process)."""
+    reg = telemetry.MetricsRegistry()
+    monkeypatch.setattr(telemetry, "REGISTRY", reg)
+    return reg
+
+
+def _http_counter():
+    return telemetry.registry().counter(
+        "pio_http_requests_total", "req", labelnames=("service", "status"))
+
+
+def _serve_hist():
+    return telemetry.registry().histogram(
+        "pio_serve_seconds", "serve", labelnames=("mode",))
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math
+# ---------------------------------------------------------------------------
+
+def test_availability_burn_and_budget(fresh_registry):
+    eng = slo.SLOEngine(slo.SLOConfig(availability=0.999,
+                                      fast_window_s=60.0,
+                                      slow_window_s=600.0))
+    c_ok = _http_counter().labels(service="T1", status="200")
+    c_bad = _http_counter().labels(service="T1", status="500")
+    base_ok = 1000.0
+    c_ok.inc(base_ok)
+    eng.evaluate(now=0.0)                      # baseline snapshot
+    # 5% of the next window's traffic fails: 50x the 0.1% allowance
+    c_ok.inc(950)
+    c_bad.inc(50)
+    v = eng.evaluate(now=100.0)["availability"]
+    assert v["burn_fast"] == pytest.approx(0.05 / 0.001, rel=1e-6)
+    assert v["burn_slow"] == pytest.approx(0.05 / 0.001, rel=1e-6)
+    # lifetime budget: 50 bad / 2000 total = 2.5% bad vs 0.1% allowed
+    assert v["budget_remaining"] == pytest.approx(1 - 0.025 / 0.001,
+                                                  rel=1e-6)
+
+
+def test_burn_rate_windows_are_independent(fresh_registry):
+    eng = slo.SLOEngine(slo.SLOConfig(availability=0.99,
+                                      fast_window_s=60.0,
+                                      slow_window_s=600.0))
+    c_ok = _http_counter().labels(service="T2", status="200")
+    c_bad = _http_counter().labels(service="T2", status="503")
+    eng.evaluate(now=0.0)
+    # old errors, then a long clean stretch
+    c_bad.inc(10)
+    c_ok.inc(90)
+    eng.evaluate(now=100.0)
+    c_ok.inc(900)
+    v = eng.evaluate(now=650.0)
+    # fast window (last 60 s): only clean traffic -> burn 0
+    assert v["availability"]["burn_fast"] == 0.0
+    # slow window still remembers the bad stretch
+    assert v["availability"]["burn_slow"] > 0.0
+
+
+def test_latency_objective_reads_serve_histogram(fresh_registry):
+    eng = slo.SLOEngine(slo.SLOConfig(latency_ms=25.0,
+                                      latency_target=0.99,
+                                      fast_window_s=60.0,
+                                      slow_window_s=600.0))
+    h = _serve_hist().labels(mode="batched")
+    eng.evaluate(now=0.0)
+    for _ in range(99):
+        h.observe(0.001)          # well under 25 ms
+    h.observe(1.0)                # one slow outlier: exactly on target
+    v = eng.evaluate(now=30.0)["latency"]
+    assert v["total"] >= 100
+    assert v["burn_fast"] == pytest.approx(1.0, rel=0.2)
+
+
+def test_idle_windows_burn_zero(fresh_registry):
+    eng = slo.SLOEngine(slo.SLOConfig())
+    v = eng.evaluate(now=0.0)
+    for s in ("availability", "latency"):
+        assert v[s]["burn_fast"] == 0.0
+        assert v[s]["burn_slow"] == 0.0
+        assert v[s]["budget_remaining"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# collector + wire parity
+# ---------------------------------------------------------------------------
+
+def test_collector_emits_nothing_with_telemetry_off():
+    eng = slo.install()
+    telemetry.set_enabled(False)
+    assert list(eng.collect()) == []
+    assert "pio_slo_" not in telemetry.registry().exposition()
+
+
+def test_collector_series_with_telemetry_on():
+    eng = slo.install()
+    telemetry.set_enabled(True)
+    lines = list(eng.collect())
+    text = "\n".join(lines)
+    samples = doctor.parse_metrics(text)
+    assert 'pio_slo_target' in samples
+    assert len(samples["pio_slo_burn_rate"]) == 4   # 2 slos x 2 windows
+    assert len(samples["pio_slo_error_budget_remaining"]) == 2
+    # and the full registry scrape carries them too
+    assert "pio_slo_burn_rate" in telemetry.registry().exposition()
+
+
+def test_server_config_targets_override_env(memory_storage):
+    from predictionio_tpu.workflow.create_server import ServerConfig
+    cfg = ServerConfig(slo_availability=0.95, slo_latency_ms=5.0)
+    # mirror QueryAPI's install call without a full engine deploy
+    slo.install(slo.SLOConfig.from_env(
+        availability=cfg.slo_availability,
+        latency_ms=cfg.slo_latency_ms,
+        latency_target=cfg.slo_latency_target))
+    eng = slo.engine()
+    assert eng.config.availability == 0.95
+    assert eng.config.latency_ms == 5.0
+    # a later default install (event server in the same process) must
+    # not clobber the configured targets
+    slo.install()
+    assert slo.engine().config.availability == 0.95
+
+
+def test_env_defaults(monkeypatch):
+    monkeypatch.setenv("PIO_SLO_AVAILABILITY", "0.9995")
+    monkeypatch.setenv("PIO_SLO_LATENCY_MS", "12.5")
+    cfg = slo.SLOConfig.from_env()
+    assert cfg.availability == 0.9995
+    assert cfg.latency_ms == 12.5
+    assert cfg.latency_target == 0.99
+
+
+# ---------------------------------------------------------------------------
+# pio doctor SLO line
+# ---------------------------------------------------------------------------
+
+def _scraped(metrics_body="", device=None):
+    ok = {"status": 200, "body": json.dumps({"status": "ok"})}
+    return {
+        "url": "http://t", "healthz": dict(ok), "readyz": dict(ok),
+        "metrics": {"status": 200, "body": metrics_body},
+        "traces": {"status": 404, "body": ""},
+        "device": {"status": 200,
+                   "body": json.dumps(device or {"telemetry": True})},
+    }
+
+
+def _check(checks, name):
+    return next(c for c in checks if c[0] == name)
+
+
+def test_doctor_slo_green_within_budget():
+    body = ('pio_slo_burn_rate{slo="availability",window="fast"} 0.5\n'
+            'pio_slo_burn_rate{slo="availability",window="slow"} 0.2\n'
+            'pio_slo_burn_rate{slo="latency",window="fast"} 0\n'
+            'pio_slo_burn_rate{slo="latency",window="slow"} 0\n'
+            'pio_slo_error_budget_remaining{slo="availability"} 0.98\n'
+            'pio_slo_error_budget_remaining{slo="latency"} 1\n')
+    check = _check(doctor.diagnose(_scraped(body)), "slo")
+    assert check[1] == doctor.OK
+    assert "budget" in check[2]
+
+
+def test_doctor_slo_red_when_fast_burn_alight():
+    body = ('pio_slo_burn_rate{slo="availability",window="fast"} 20\n'
+            'pio_slo_burn_rate{slo="availability",window="slow"} 15\n'
+            'pio_slo_error_budget_remaining{slo="availability"} 0.4\n')
+    checks = doctor.diagnose(_scraped(body))
+    check = _check(checks, "slo")
+    assert check[1] == doctor.RED
+    assert "availability" in check[2] and "20.0x" in check[2]
+    # a RED slo check fails the verdict
+    assert any(s == doctor.RED for _c, s, _d in checks)
+
+
+def test_doctor_slo_warn_on_slow_burn_only():
+    body = ('pio_slo_burn_rate{slo="latency",window="fast"} 2\n'
+            'pio_slo_burn_rate{slo="latency",window="slow"} 8\n'
+            'pio_slo_error_budget_remaining{slo="latency"} 0.7\n')
+    check = _check(doctor.diagnose(_scraped(body)), "slo")
+    assert check[1] == doctor.WARN
+    assert "latency" in check[2]
+
+
+def test_doctor_distinguishes_telemetry_off_from_missing_stats():
+    """The satellite: {"telemetry": false} means PIO_TELEMETRY is
+    unset — doctor prints the opt-in hint, not the misleading
+    'no device memory stats (CPU)' line; with telemetry ON and still no
+    HBM series, the genuine CPU/unsupported line stays."""
+    off = doctor.diagnose(_scraped("", device={"telemetry": False}))
+    for name in ("hbm", "slo", "serving"):
+        check = _check(off, name)
+        assert check[1] == doctor.NA
+        assert "PIO_TELEMETRY=1" in check[2], (name, check)
+        assert "KNOWN_ISSUES" not in check[2]
+    on = doctor.diagnose(_scraped("", device={"telemetry": True}))
+    hbm = _check(on, "hbm")
+    assert hbm[1] == doctor.NA
+    assert "CPU / unsupported" in hbm[2]
+    assert "PIO_TELEMETRY" not in hbm[2]
+
+
+def test_doctor_waterfall_line():
+    slow_ok = {"status": 200, "body": json.dumps({
+        "enabled": True, "capacity": 32, "sampleEvery": 1,
+        "requests": [{"traceId": "ab12", "mode": "batched",
+                      "totalMs": 8.2,
+                      "stages": {"dispatch": 1.0, "pad": 6.5}}]})}
+    scraped = _scraped()
+    scraped["slow"] = slow_ok
+    check = _check(doctor.diagnose(scraped), "waterfall")
+    assert check[1] == doctor.OK
+    assert "pad" in check[2] and "ab12" in check[2]
+    # sampling off -> NA with the opt-in hint
+    scraped["slow"] = {"status": 200,
+                       "body": json.dumps({"enabled": False,
+                                           "requests": []})}
+    check = _check(doctor.diagnose(scraped), "waterfall")
+    assert check[1] == doctor.NA
+    assert "PIO_WATERFALL=1" in check[2]
+    # legacy daemon without the route at all
+    check = _check(doctor.diagnose(_scraped()), "waterfall")
+    assert check[1] == doctor.NA

@@ -257,7 +257,13 @@ def parse_prometheus(text):
             types[name] = kind
             continue
         assert not line.startswith("#"), f"unknown comment: {line}"
-        m = _SAMPLE_RE.match(line)
+        # OpenMetrics exemplar suffix (waterfall stage histograms):
+        # validate its grammar, then parse the sample body as usual
+        body, ex_sep, exemplar = line.partition(" # ")
+        if ex_sep:
+            assert re.fullmatch(r'\{[^{}]*\}\s+\S+', exemplar.strip()), \
+                f"malformed exemplar: {line!r}"
+        m = _SAMPLE_RE.match(body)
         assert m, f"unparseable sample line: {line!r}"
         name, labels, value = m.groups()
         if labels:

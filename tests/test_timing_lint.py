@@ -90,11 +90,12 @@ def test_no_wall_clock_time_in_package():
 #: kernels may legitimately use it for non-timing dispatch control.)
 _TIMED_MODULES = (
     "common/telemetry.py", "common/tracing.py", "common/devicewatch.py",
+    "common/waterfall.py", "common/profiling.py", "common/slo.py",
     "serving/batcher.py", "serving/aot.py",
     "workflow/context.py", "workflow/core_workflow.py",
     "workflow/create_server.py", "data/store.py", "ops/staging.py",
     "models/recommendation/als_algorithm.py",
-    "tools/benchtrend.py", "tools/doctor.py",
+    "tools/benchtrend.py", "tools/doctor.py", "tools/profile.py",
 )
 
 
@@ -115,6 +116,93 @@ def test_no_block_until_ready_in_timed_modules():
         "tunneled platforms (KNOWN_ISSUES #3); end the region in a real "
         "host transfer (jax.device_get) instead:\n  "
         + "\n  ".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# debug-surface lint: every /debug/* endpoint must ride the SHARED
+# telemetry.handle_route so the three daemons can never drift apart
+# (the event server once lacked a surface the query server had; this
+# makes that class of bug a failing tier-1 test)
+# ---------------------------------------------------------------------------
+
+#: the daemon route handlers that must consult telemetry.handle_route
+_DAEMON_MODULES = (
+    "workflow/create_server.py",   # query server (QueryAPI.handle)
+    "data/api/service.py",         # event server (EventAPI._route)
+    "data/storage/remote.py",      # storage server (StorageRPCAPI.handle)
+)
+
+
+def _debug_string_constants(tree):
+    return {node.value for node in ast.walk(tree)
+            if isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("/debug/")}
+
+
+def test_debug_endpoints_only_defined_in_shared_handle_route():
+    """Every /debug/* path compared anywhere in the package must be one
+    telemetry.DEBUG_PATHS serves — a debug endpoint wired into a single
+    daemon's private route table would drift off the other two."""
+    from predictionio_tpu.common import telemetry
+    offenders = []
+    for path in _py_files():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        if "/debug/" not in src:
+            continue
+        tree = ast.parse(src, filename=path)
+        for const in _debug_string_constants(tree):
+            # startswith-match so query-bearing scrape paths
+            # ("/debug/slow.json?limit=3") stay legal
+            if not any(const == p or const.startswith(p + "?")
+                       for p in telemetry.DEBUG_PATHS):
+                rel = os.path.relpath(path, os.path.dirname(PKG))
+                offenders.append(f"{rel}: {const!r}")
+    assert not offenders, (
+        "debug endpoint(s) referenced outside telemetry.DEBUG_PATHS — "
+        "register them in common/telemetry.py handle_route so all three "
+        "daemons serve them:\n  " + "\n  ".join(offenders))
+
+
+def test_every_daemon_consults_shared_handle_route():
+    """Each daemon's route handler must call telemetry.handle_route —
+    that one call is what puts every DEBUG_PATHS surface (and /metrics,
+    /traces.json) on its wire."""
+    missing = []
+    for rel in _DAEMON_MODULES:
+        path = os.path.join(PKG, rel)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        calls = [n for n in ast.walk(tree)
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr == "handle_route"
+                 and isinstance(n.func.value, ast.Name)
+                 and n.func.value.id == "telemetry"]
+        if not calls:
+            missing.append(rel)
+    assert not missing, (
+        "daemon route handler(s) never call telemetry.handle_route — "
+        "their /debug/* surface has drifted off:\n  "
+        + "\n  ".join(missing))
+
+
+def test_debug_paths_answer_on_event_and_storage_daemons(memory_storage):
+    """Runtime half of the lint: every DEBUG_PATHS surface answers
+    (non-404) on the two cheap daemons. The query server's identical
+    surface is covered by the waterfall e2e test (it needs a trained
+    model)."""
+    from predictionio_tpu.common import telemetry
+    from predictionio_tpu.data.api import EventAPI
+    from predictionio_tpu.data.storage.remote import StorageRPCAPI
+    apis = (EventAPI(storage=memory_storage),
+            StorageRPCAPI(memory_storage, key="sekrit"))
+    for api in apis:
+        for path in telemetry.DEBUG_PATHS:
+            response = api.handle("GET", path)
+            assert response[0] == 200, (type(api).__name__, path,
+                                        response)
 
 
 def test_lint_actually_detects_violations():
