@@ -25,6 +25,14 @@ timer thread:
   a ``capture.json`` metadata file; ``GET /debug/profile`` lists paths
   and sizes. The operator opens the trace with xprof — the daemon never
   serves multi-MB protobufs on its request path.
+- **Confined writes** — the endpoint shares the unauthenticated debug
+  surface with ``/metrics``, but unlike a read-only counter page a POST
+  writes to disk, so the ``dir`` override is resolved against
+  ``PIO_PROFILE_DIR`` and refused (400) if it escapes it — absolute
+  paths, ``..`` hops and symlink detours included. A client can only
+  ever pick a *subdirectory* of the operator-chosen base. Operators who
+  want the endpoint fully inert set ``PIO_PROFILE_ENABLE=0`` (POST
+  answers 403; GET listing stays).
 
 ``pio profile <url> --ms 2000`` (tools/profile.py) drives the endpoint
 against a live server and waits for the artifact listing.
@@ -78,6 +86,33 @@ def max_ms() -> int:
 def base_dir() -> str:
     return (os.environ.get("PIO_PROFILE_DIR")
             or os.path.join(tempfile.gettempdir(), "pio-profiles"))
+
+
+def post_enabled() -> bool:
+    """May HTTP clients start captures? ``PIO_PROFILE_ENABLE=0`` turns
+    the POST surface off (403) for operators who want the debug port
+    strictly read-only; GET listing and the in-process paths
+    (:func:`start_capture`, :class:`trace`) are unaffected."""
+    return os.environ.get("PIO_PROFILE_ENABLE", "1") != "0"
+
+
+def resolve_http_dir(raw: Optional[str]) -> Optional[str]:
+    """Confine an HTTP-supplied ``dir`` override to :func:`base_dir`.
+
+    The debug surface is unauthenticated, so the query param must never
+    become an arbitrary-path write primitive: the value is resolved
+    (``realpath``, so ``..`` and symlink escapes collapse) and must stay
+    under the operator-configured base. Returns the resolved directory,
+    or None when no override was given; raises ValueError on escape."""
+    if not raw:
+        return None
+    base = os.path.realpath(base_dir())
+    resolved = os.path.realpath(os.path.join(base, raw))
+    if resolved != base and not resolved.startswith(base + os.sep):
+        raise ValueError(
+            "dir must stay under the server's profile base directory "
+            f"({base_dir()}); pass a relative subdirectory")
+    return resolved
 
 
 def _now_iso() -> str:
@@ -274,6 +309,9 @@ def handle_route(method: str, query: Optional[Dict[str, str]] = None):
         return 200, list_captures()
     if method != "POST":
         return 405, {"message": "method not allowed"}
+    if not post_enabled():
+        return 403, {"message": "on-demand profiling is disabled "
+                                "(PIO_PROFILE_ENABLE=0)"}
     q = query or {}
     raw_ms = q.get("ms", "")
     try:
@@ -281,7 +319,11 @@ def handle_route(method: str, query: Optional[Dict[str, str]] = None):
     except ValueError:
         return 400, {"message": f"ms must be an integer, got {raw_ms!r}"}
     try:
-        entry = start_capture(ms=ms, out_dir=q.get("dir") or None)
+        out_dir = resolve_http_dir(q.get("dir"))
+    except ValueError as e:
+        return 400, {"message": str(e)}
+    try:
+        entry = start_capture(ms=ms, out_dir=out_dir)
     except CaptureBusy as e:
         return 409, {"message": str(e)}
     except ValueError as e:

@@ -138,7 +138,10 @@ class Histogram:
     bucket remember the most recent trace id (+ its value), exposed in
     OpenMetrics exemplar syntax on the ``_bucket`` line — the waterfall
     stage histograms use this so an alert on a bucket leads straight to
-    a concrete request in ``/debug/slow.json`` / ``/traces.json``."""
+    a concrete request in ``/debug/slow.json`` / ``/traces.json``.
+    Exemplars ride only the negotiated OpenMetrics exposition; the
+    classic 0.0.4 format stays exemplar-free (its parser would read
+    one as a timestamp)."""
 
     __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count",
                  "_exemplars")
@@ -308,6 +311,19 @@ def _escape_label(v: str) -> str:
             .replace('"', r'\"'))
 
 
+def _openmetrics_meta_line(line: str) -> str:
+    """Rewrite a collector-emitted ``# TYPE x_total counter`` line to
+    OpenMetrics family naming (collectors emit classic 0.0.4 lines;
+    their sample lines already carry the ``_total`` suffix and need no
+    change)."""
+    if line.startswith("# TYPE ") and line.endswith(" counter"):
+        name = line[len("# TYPE "):-len(" counter")]
+        if name.endswith("_total"):
+            return f"# TYPE {name[:-len('_total')]} counter"
+        return f"# TYPE {name} unknown"
+    return line
+
+
 def _fmt_number(v: float) -> str:
     if v == _INF:
         return "+Inf"
@@ -380,16 +396,34 @@ class MetricsRegistry:
             self._collectors.append(ref)
 
     # ----------------------------------------------------------- exposition
-    def exposition(self) -> str:
-        """The registry in Prometheus text format 0.0.4."""
+    def exposition(self, openmetrics: bool = False) -> str:
+        """The registry as text exposition.
+
+        Default is classic Prometheus text format 0.0.4 with NO exemplar
+        suffixes: the 0.0.4 parser reads the token after a sample value
+        as a timestamp, so one exemplar would fail the line (and with
+        it the scrape). Exemplars are OpenMetrics-only syntax — pass
+        ``openmetrics=True`` (negotiated from the scraper's ``Accept``
+        header by :func:`handle_route`) to get them, plus the
+        ``# EOF`` terminator and OpenMetrics counter-family naming
+        (``# TYPE x counter`` with ``x_total`` samples)."""
         out: List[str] = []
         with self._lock:
             families = sorted(self._families.values(), key=lambda f: f.name)
             collectors = list(self._collectors)
         for fam in families:
+            meta_name, meta_kind = fam.name, fam.kind
+            if openmetrics and fam.kind == "counter":
+                # OpenMetrics: a counter family is named WITHOUT the
+                # _total sample suffix; a counter that never had one is
+                # exposed as `unknown` so strict parsers keep reading
+                if fam.name.endswith("_total"):
+                    meta_name = fam.name[:-len("_total")]
+                else:
+                    meta_kind = "unknown"
             if fam.help:
-                out.append(f"# HELP {fam.name} {fam.help}")
-            out.append(f"# TYPE {fam.name} {fam.kind}")
+                out.append(f"# HELP {meta_name} {fam.help}")
+            out.append(f"# TYPE {meta_name} {meta_kind}")
             for name, labels, value, *rest in fam.samples():
                 if labels:
                     lab = ",".join(
@@ -397,11 +431,9 @@ class MetricsRegistry:
                     line = f"{name}{{{lab}}} {_fmt_number(value)}"
                 else:
                     line = f"{name} {_fmt_number(value)}"
-                if rest and rest[0] is not None:
-                    # OpenMetrics exemplar: the bucket's most recent
-                    # trace id + observed value (waterfall stage
-                    # histograms; parsers that predate exemplars strip
-                    # from " # " — doctor's does)
+                if openmetrics and rest and rest[0] is not None:
+                    # exemplar: the bucket's most recent trace id +
+                    # observed value (waterfall stage histograms)
                     ex_id, ex_v = rest[0]
                     line += (f' # {{trace_id="{_escape_label(ex_id)}"}} '
                              f"{_fmt_number(ex_v)}")
@@ -413,13 +445,18 @@ class MetricsRegistry:
                 dead.append(ref)
                 continue
             try:
-                out.extend(fn())
+                lines = list(fn())
             except Exception:      # a broken collector must not kill scrapes
                 continue
+            if openmetrics:
+                lines = [_openmetrics_meta_line(ln) for ln in lines]
+            out.extend(lines)
         if dead:
             with self._lock:
                 self._collectors = [c for c in self._collectors
                                     if c not in dead]
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
     def reset(self) -> None:
@@ -466,8 +503,23 @@ class RegistryDict:
 # shared daemon routes: GET /metrics and GET /traces.json
 # ---------------------------------------------------------------------------
 
-#: Prometheus text exposition content type
+#: Prometheus text exposition content type (classic 0.0.4 — the default)
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: OpenMetrics content type, served only when the scraper's Accept
+#: header asks for it — the format that carries the exemplar suffixes
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+
+def accepts_openmetrics(accept: Optional[str]) -> bool:
+    """Does this Accept header negotiate OpenMetrics? A plain substring
+    check is enough: Prometheus lists ``application/openmetrics-text``
+    with a q-value when (and only when) it can parse it; classic 0.0.4
+    scrapers never send the token and must never receive exemplars
+    (their parser reads the exemplar as a timestamp and fails the
+    line)."""
+    return "application/openmetrics-text" in (accept or "").lower()
 
 
 #: /traces.json?limit= ceiling: a scraper typo (limit=1e9) must not ask
@@ -485,13 +537,21 @@ DEBUG_PATHS: Tuple[str, ...] = (
 
 
 def handle_route(method: str, path: str,
-                 query: Optional[Dict[str, str]] = None):
+                 query: Optional[Dict[str, str]] = None,
+                 accept: Optional[str] = None):
     """Serve ``GET /metrics`` / ``GET /traces.json`` / the ``/debug/*``
     surfaces (``device.json``, ``slow.json``, ``profile``) for any
     daemon's route handler; returns None when the request is not a
     telemetry route (the handler continues with its own table).
-    Unauthenticated by design, like ``/healthz`` — the payload is
-    operational counters, not data.
+    The read surfaces are unauthenticated by design, like ``/healthz``
+    — the payload is operational counters, not data; the one write
+    surface (``POST /debug/profile``) confines its effects to the
+    operator-configured profile directory and can be disabled outright
+    (see :mod:`profiling`).
+
+    ``accept`` is the request's Accept header: a scraper negotiating
+    ``application/openmetrics-text`` gets OpenMetrics exposition with
+    exemplars; everyone else gets classic 0.0.4 without them.
 
     /traces.json accepts ``?limit=N`` (bounds-checked: clamped to
     [1, 1024], default 64) and ``?trace_id=<id>`` so `pio doctor` and
@@ -505,8 +565,10 @@ def handle_route(method: str, path: str,
     if method != "GET":
         return None
     if path == "/metrics":
-        return 200, REGISTRY.exposition(), {
-            "Content-Type": EXPOSITION_CONTENT_TYPE}
+        om = accepts_openmetrics(accept)
+        return 200, REGISTRY.exposition(openmetrics=om), {
+            "Content-Type": (OPENMETRICS_CONTENT_TYPE if om
+                             else EXPOSITION_CONTENT_TYPE)}
     if path == "/debug/slow.json":
         from predictionio_tpu.common import waterfall
         limit = _TRACES_LIMIT_DEFAULT

@@ -25,8 +25,11 @@ bucket=64" in one hop:
 
 - **Exemplars** — each stage-histogram bucket remembers the most recent
   trace id that landed in it, exposed on ``/metrics`` in OpenMetrics
-  exemplar syntax (``... 42 # {trace_id="ab12"} 0.0034``), so an
-  alerting threshold on a bucket leads straight to a concrete request.
+  exemplar syntax (``... 42 # {trace_id="ab12"} 0.0034``) when the
+  scraper negotiates ``Accept: application/openmetrics-text`` (classic
+  0.0.4 scrapes stay exemplar-free — their parser would read the
+  suffix as a timestamp), so an alerting threshold on a bucket leads
+  straight to a concrete request.
 
 - **Slow ring** — ``GET /debug/slow.json``: the N slowest sampled
   requests (``PIO_SLOW_RING``, default 32) with their full stage
@@ -248,13 +251,16 @@ class _SlowRing:
     def add(self, rec: RequestRecord) -> None:
         cap = _ring_cap()
         with self._lock:
-            if len(self._entries) >= cap:
-                slowest_min = min(self._entries, key=lambda r: r.total_s)
-                if rec.total_s <= slowest_min.total_s:
+            # evict the fastest entries until there is room under the
+            # cap — one eviction in steady state, several when
+            # PIO_SLOW_RING shrank between requests (always dropping by
+            # total_s, never by insertion order)
+            while len(self._entries) >= cap:
+                fastest = min(self._entries, key=lambda r: r.total_s)
+                if (len(self._entries) == cap
+                        and rec.total_s <= fastest.total_s):
                     return
-                self._entries.remove(slowest_min)
-            # re-cap in case PIO_SLOW_RING shrank between requests
-            del self._entries[cap:]
+                self._entries.remove(fastest)
             self._entries.append(rec)
 
     def snapshot(self, limit: int) -> List[Dict[str, Any]]:

@@ -164,7 +164,9 @@ class EventAPI:
         if path == "/healthz" and method == "GET":
             return 200, {"status": "ok"}
         from predictionio_tpu.common import telemetry
-        t = telemetry.handle_route(method, path, query)
+        t = telemetry.handle_route(
+            method, path, query,
+            accept=headers.get("accept") or headers.get("Accept"))
         if t is not None:   # /metrics, /traces.json, /debug/device.json
             return t
         if path == "/readyz" and method == "GET":

@@ -352,7 +352,8 @@ class StorageRPCAPI:
             return 200, {"status": "ok"}
         if method == "GET" and path == "/readyz":
             return self._readyz()
-        t = telemetry.handle_route(method, path, query)
+        t = telemetry.handle_route(method, path, query,
+                                   accept=headers.get("accept"))
         if t is not None:   # /metrics, /traces.json, /debug/device.json
             return t
         if self.key and not hmac.compare_digest(

@@ -665,8 +665,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture length in ms (server clamps to its "
                          "PIO_PROFILE_MAX_MS, default 10000)")
     sp.add_argument("-o", "--out", default="",
-                    help="server-side directory for the artifact "
-                         "(default: the server's PIO_PROFILE_DIR)")
+                    help="server-side subdirectory (under the server's "
+                         "PIO_PROFILE_DIR) for the artifact; paths "
+                         "escaping the base are refused (400)")
     sp.add_argument("--timeout", type=float, default=5.0,
                     help="per-request timeout in seconds")
 

@@ -3,9 +3,9 @@
 Drives the bounded on-demand capture endpoint (common/profiling.py,
 served by every daemon next to /metrics):
 
-    $ pio profile http://localhost:8000 --ms 2000 -o /tmp/profiles
+    $ pio profile http://localhost:8000 --ms 2000 -o hot-replica
     capture serve-1a2b3c4d started (2000 ms, artifacts under
-      /tmp/profiles/serve-1a2b3c4d)
+      /var/pio/profiles/hot-replica/serve-1a2b3c4d)
     capture done: 2 file(s), 48 KiB
       plugins/profile/2026_08_04_10_00_00/host.xplane.pb
       ...
@@ -13,10 +13,13 @@ served by every daemon next to /metrics):
 Flow: POST /debug/profile?ms=N[&dir=...] (202, or 409 while another
 capture runs), then poll GET /debug/profile until the capture leaves
 the running state. The artifact stays on the SERVER's filesystem —
-`-o` names a server-side directory; the daemon lists paths and sizes,
-it never streams multi-MB protobufs through its request path. Open the
-result with xprof/tensorboard, exactly like a `pio train --profile DIR`
-artifact (same layout, same capture.json metadata).
+`-o` names a SUBDIRECTORY of the server's profile base
+(`PIO_PROFILE_DIR` / `pio deploy --profile-dir`); the server refuses
+(400) anything that escapes it, so the unauthenticated debug port
+never becomes an arbitrary-path write. The daemon lists paths and
+sizes, it never streams multi-MB protobufs through its request path.
+Open the result with xprof/tensorboard, exactly like a `pio train
+--profile DIR` artifact (same layout, same capture.json metadata).
 
 Exit code: 0 when the capture produced a non-empty artifact, 1 on an
 empty/failed capture or a refused start, 2 when the daemon is

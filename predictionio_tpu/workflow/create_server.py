@@ -452,7 +452,10 @@ class QueryAPI:
                 return 200, {"status": "ok"}
             if path == "/readyz" and method == "GET":
                 return self._readyz()
-            t = telemetry.handle_route(method, path, query)
+            t = telemetry.handle_route(
+                method, path, query,
+                accept=(headers or {}).get("accept")
+                or (headers or {}).get("Accept"))
             if t is not None:    # /metrics, /traces.json, /debug/device.json
                 return t
             if path == "/queries.json" and method == "POST":

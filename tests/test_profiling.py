@@ -96,7 +96,8 @@ def test_debug_profile_route_get_and_post(memory_storage):
 
 def test_pio_profile_cli_against_live_daemon(memory_storage, tmp_path):
     """The acceptance path: `pio profile <url>` against a live
-    in-process daemon yields a non-empty trace artifact."""
+    in-process daemon yields a non-empty trace artifact. `-o` names a
+    subdirectory under the server's PIO_PROFILE_DIR."""
     api = EventAPI(storage=memory_storage)
     server, port = serve_background(api, "127.0.0.1", 0)
     try:
@@ -104,18 +105,55 @@ def test_pio_profile_cli_against_live_daemon(memory_storage, tmp_path):
         float(jnp.ones((64, 64)).sum())
         buf = io.StringIO()
         rc = run_profile(f"http://127.0.0.1:{port}", ms=400,
-                         out_dir=str(tmp_path / "cli-capture"), out=buf)
+                         out_dir="cli-capture", out=buf)
         text = buf.getvalue()
         assert rc == 0, text
         assert "capture done" in text
         assert "file(s)" in text
-        # artifact landed under the requested server-side dir
+        # artifact landed under the requested server-side subdir,
+        # confined to the profile base
         listing = profiling.list_captures()
         assert listing["captures"][0]["dir"].startswith(
-            str(tmp_path / "cli-capture"))
+            str(tmp_path / "profiles" / "cli-capture"))
         assert listing["captures"][0]["files"]
     finally:
         server.shutdown()
+
+
+def test_debug_profile_dir_confined_to_base(memory_storage, tmp_path):
+    """The unauthenticated POST must never write outside the
+    operator-configured profile base: absolute paths, `..` hops, and
+    anything else resolving outside PIO_PROFILE_DIR answer 400 with no
+    capture started; a path inside the base is accepted."""
+    api = EventAPI(storage=memory_storage)
+    for bad in (str(tmp_path / "evil"), "../evil", "a/../../evil",
+                "/etc/cron.d"):
+        st, payload = api.handle("POST", "/debug/profile",
+                                 query={"ms": "100", "dir": bad})
+        assert st == 400, (bad, st, payload)
+        assert "profile base" in payload["message"]
+        assert profiling.list_captures()["active"] is None
+        assert not (tmp_path / "evil").exists()
+    # in-base override (relative, or absolute under the base) is fine
+    st, payload = api.handle("POST", "/debug/profile",
+                             query={"ms": "50", "dir": "sub"})
+    assert st == 202
+    assert payload["capture"]["dir"].startswith(
+        str(tmp_path / "profiles" / "sub"))
+    _wait_done(payload["capture"]["id"])
+
+
+def test_debug_profile_post_kill_switch(memory_storage, monkeypatch):
+    """PIO_PROFILE_ENABLE=0 turns the POST surface off (403) while the
+    GET listing keeps answering."""
+    monkeypatch.setenv("PIO_PROFILE_ENABLE", "0")
+    api = EventAPI(storage=memory_storage)
+    st, payload = api.handle("POST", "/debug/profile",
+                             query={"ms": "100"})
+    assert st == 403 and "PIO_PROFILE_ENABLE" in payload["message"]
+    assert profiling.list_captures()["active"] is None
+    st, listing = api.handle("GET", "/debug/profile")
+    assert st == 200 and "captures" in listing
 
 
 def test_pio_profile_cli_unreachable_exits_2():
@@ -131,8 +169,7 @@ def test_cli_profile_subcommand_wiring(memory_storage, tmp_path):
     try:
         float(jnp.ones((64, 64)).sum())
         rc = cli_main(["profile", f"http://127.0.0.1:{port}",
-                       "--ms", "300",
-                       "-o", str(tmp_path / "sub-capture")])
+                       "--ms", "300", "-o", "sub-capture"])
         assert rc == 0
     finally:
         server.shutdown()

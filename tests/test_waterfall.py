@@ -116,6 +116,35 @@ def test_stages_accumulate_and_ring_keeps_slowest(monkeypatch):
         assert r["traceId"]
 
 
+def test_ring_shrink_evicts_fastest_and_respects_new_cap(monkeypatch):
+    """When PIO_SLOW_RING shrinks between requests, the ring must drop
+    its FASTEST entries (never by insertion order) and settle at the
+    new cap exactly."""
+    waterfall.set_enabled(True)
+    monkeypatch.setenv("PIO_SLOW_RING", "6")
+    for i in range(6):
+        rec = waterfall.begin("inline")
+        # insertion order deliberately != slowness order
+        rec.total_s = 0.001 * ((i * 3) % 7 + 1)
+        waterfall._ring.add(rec)
+    monkeypatch.setenv("PIO_SLOW_RING", "2")
+    rec = waterfall.begin("inline")
+    rec.total_s = 0.0045
+    waterfall._ring.add(rec)
+    snap = waterfall.slow_snapshot()
+    totals = [r["totalMs"] for r in snap["requests"]]
+    # exactly the new cap, holding the two slowest seen overall
+    assert len(totals) == 2
+    assert totals == sorted(totals, reverse=True)
+    assert min(totals) >= 4.5
+    # a fast request arriving now must not displace anything
+    rec = waterfall.begin("inline")
+    rec.total_s = 0.0001
+    waterfall._ring.add(rec)
+    assert [r["totalMs"] for r in waterfall.slow_snapshot()["requests"]] \
+        == totals
+
+
 def test_sampling_every_nth(monkeypatch):
     waterfall.set_enabled(True)
     monkeypatch.setenv("PIO_WATERFALL_SAMPLE", "4")
@@ -132,14 +161,14 @@ def test_record_adopts_active_trace_id():
     assert rec.trace_id == ctx.trace_id
 
 
-def test_histogram_exemplars_in_exposition():
+def test_histogram_exemplars_in_openmetrics_exposition():
     reg = telemetry.MetricsRegistry()
     h = reg.histogram("x_seconds", "t", labelnames=("stage",),
                       buckets=(0.001, 0.1)).labels(stage="pad")
     h.observe(0.0005, exemplar="trace-a")
     h.observe(5.0, exemplar="trace-b")
     h.observe(0.0004)   # no exemplar: must not clobber trace-a
-    text = reg.exposition()
+    text = reg.exposition(openmetrics=True)
     a = re.search(r'x_seconds_bucket\{stage="pad",le="0\.001"\} 2 '
                   r'# \{trace_id="trace-a"\} 0\.0005', text)
     b = re.search(r'x_seconds_bucket\{stage="pad",le="\+Inf"\} 3 '
@@ -148,6 +177,46 @@ def test_histogram_exemplars_in_exposition():
     # sum/count lines stay exemplar-free
     assert re.search(r"x_seconds_count\{stage=\"pad\"\} 3\s*$", text,
                      re.M)
+    # OpenMetrics exposition terminates with # EOF
+    assert text.endswith("# EOF\n")
+
+
+def test_classic_exposition_never_carries_exemplars():
+    """Exemplars are OpenMetrics-only syntax: the classic 0.0.4 parser
+    reads the token after the value as a timestamp and fails the line,
+    so the default exposition must stay exemplar-free even after one
+    was recorded."""
+    reg = telemetry.MetricsRegistry()
+    reg.histogram("x_seconds", "t", labelnames=("stage",),
+                  buckets=(0.001,)).labels(stage="pad").observe(
+        0.0005, exemplar="trace-a")
+    # a counter family rides along to pin classic TYPE naming
+    reg.counter("x_events_total", "t").child().inc()
+    text = reg.exposition()
+    assert " # {" not in text, text
+    assert "# EOF" not in text
+    assert "# TYPE x_events_total counter" in text
+    # openmetrics mode strips the counter family's _total suffix in
+    # the meta lines (sample lines keep it)
+    om = reg.exposition(openmetrics=True)
+    assert "# TYPE x_events counter" in om
+    assert re.search(r"^x_events_total 1$", om, re.M), om
+
+
+def test_metrics_route_negotiates_openmetrics():
+    """/metrics answers classic 0.0.4 by default and OpenMetrics (with
+    the matching Content-Type) only when the Accept header asks."""
+    st, body, hdrs = telemetry.handle_route("GET", "/metrics")
+    assert st == 200
+    assert hdrs["Content-Type"].startswith("text/plain")
+    assert "# EOF" not in body
+    st, body, hdrs = telemetry.handle_route(
+        "GET", "/metrics",
+        accept="application/openmetrics-text;version=1.0.0;q=0.75,"
+               "text/plain;version=0.0.4;q=0.5")
+    assert st == 200
+    assert hdrs["Content-Type"].startswith("application/openmetrics-text")
+    assert body.endswith("# EOF\n")
 
 
 def test_doctor_parser_strips_exemplars():
@@ -211,9 +280,21 @@ def test_stage_breakdown_reconstructable_end_to_end(memory_storage,
         # the flush's padding bucket rode along as the diagnosis detail
         assert top["details"]["bucket"] >= 1
         # exemplar join: some stage bucket on /metrics names a trace id
-        # from the slow ring — alarm -> exemplar -> slow.json in one hop
-        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        # from the slow ring — alarm -> exemplar -> slow.json in one
+        # hop. Exemplars ride the OpenMetrics exposition only, so the
+        # scrape negotiates it via Accept...
+        om_req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(om_req, timeout=10) as r:
+            assert r.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
             text = r.read().decode()
+        # ...while a classic scraper (no Accept) stays exemplar-free —
+        # its 0.0.4 parser would read the exemplar as a timestamp
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            assert " # {" not in r.read().decode()
         exemplar_ids = set(re.findall(
             r'pio_serve_stage_seconds_bucket\{[^}]*\}[^#\n]*'
             r'# \{trace_id="([^"]+)"\}', text))
