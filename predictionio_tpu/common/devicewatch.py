@@ -184,6 +184,20 @@ def note_aot(summary: Optional[Dict[str, Any]]) -> None:
         _aot_state = dict(summary) if summary is not None else None
 
 
+#: most recent sharded-serving layout (parallel/serve_dist.py via
+#: note_sharding); /debug/device.json and `pio doctor` read it
+_sharding_state: Optional[Dict[str, Any]] = None
+
+
+def note_sharding(summary: Optional[Dict[str, Any]]) -> None:
+    """Record (or with None, clear) the deploy's sharded-serving layout
+    (shard count, merge strategy, per-shard bytes) for the debug
+    surface."""
+    global _sharding_state
+    with _lock:
+        _sharding_state = dict(summary) if summary is not None else None
+
+
 def serving_warmup_done() -> bool:
     with _lock:
         return _warmup_done
@@ -467,6 +481,8 @@ def debug_snapshot() -> Dict[str, Any]:
             "recentPostWarmup": list(_post_warmup_events),
         }
         aot_state = dict(_aot_state) if _aot_state is not None else None
+        sharding_state = (dict(_sharding_state)
+                          if _sharding_state is not None else None)
     watchdog["compilesTotal"] = compiles_total()
     watchdog["postWarmupRecompiles"] = post_warmup_recompiles()
     with CircuitBreaker._registry_lock:
@@ -476,6 +492,7 @@ def debug_snapshot() -> Dict[str, Any]:
         "telemetry": True,
         "watchdog": watchdog,
         "aot": aot_state,
+        "sharding": sharding_state,
         "devices": _device_stats(),
         "liveArrays": _live_array_stats(),
         "compileCache": {"dir": compile_cache_dir(),

@@ -216,6 +216,14 @@ def observe_stage(stage: str, duration_s: float,
         duration_s, exemplar=recs[0].trace_id)
 
 
+def note(key: str, value: Any) -> None:
+    """Attach free-form detail to every active record (e.g. the shard
+    count a flush's sharded execute spanned). No-op when sampling is
+    off — same one-getattr cost as stage()."""
+    for r in getattr(_tls, "recs", ()):
+        r.note(key, value)
+
+
 @contextlib.contextmanager
 def stage(name: str) -> Iterator[None]:
     """Time the block as stage ``name`` for every active record. With no

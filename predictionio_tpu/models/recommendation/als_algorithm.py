@@ -42,12 +42,22 @@ class ALSAlgorithmParams(Params):
 @dataclass
 class ALSModel:
     """Factor matrices + vocabs (ALSModel.scala: MatrixFactorizationModel +
-    the two BiMaps). Arrays may be jax.Array (serving) or numpy (persisted)."""
+    the two BiMaps). Arrays may be jax.Array (serving) or numpy (persisted).
+
+    ``sharding`` is serve-time-only state (parallel/serve_dist.py): when
+    prepare_serving chose the row-sharded layout it holds the
+    ShardedFactors handle (mesh + padded shard arrays + the sharded
+    top-k program) and ``user_factors``/``item_factors`` alias the
+    PADDED sharded device arrays. Persisted blobs never carry it —
+    serialization happens on the train output, where it is None — and
+    loaders of pre-sharding pickles simply lack the attribute, hence
+    the defensive ``getattr(model, "sharding", None)`` at every read."""
     rank: int
     user_factors: "np.ndarray"   # (n_users, rank)
     item_factors: "np.ndarray"   # (n_items, rank)
     user_vocab: BiMap
     item_vocab: BiMap
+    sharding: Optional[object] = None
 
     def __str__(self) -> str:
         return (f"ALSModel(rank={self.rank}, users={len(self.user_vocab)}, "
@@ -267,19 +277,50 @@ class ALSAlgorithm(Algorithm):
     def prepare_serving(self, model: ALSModel) -> ALSModel:
         """Pick the serving path by MEASURING the deployed device.
 
-        Device-resident serving (one fused dispatch per query,
-        topk.topk_for_user) wins on a locally-attached TPU; when the chip
-        is remote/tunneled or the model is tiny, per-dispatch latency
-        dominates and host BLAS + argpartition is faster. Probe a real
-        query at deploy time — whether the factors arrive as device
-        arrays (fresh train) or host numpy (loaded blob) — and keep
-        whichever layout serves faster (threshold PIO_SERVE_DEVICE_MS,
-        default 3 ms). No reference analogue — MLlib serving is always
-        JVM-host-side."""
+        Sharded first (parallel/serve_dist.py): when the deploy scope
+        resolves shard-serving on (`pio deploy --shard-serving`,
+        PIO_SERVE_SHARD), both factor matrices are laid out row-sharded
+        over the mesh and every query serves from the per-device local
+        top-k + merge kernel — the per-device HBM footprint drops to
+        total/n_dev, which is what lets a factor matrix larger than one
+        chip serve at all. Results are bit-identical to the replicated
+        path. A failed shard layout degrades to the replicated probe
+        below, never to a dead deploy.
+
+        Otherwise: device-resident replicated serving (one fused
+        dispatch per query, topk.topk_for_user) wins on a locally-
+        attached TPU; when the chip is remote/tunneled or the model is
+        tiny, per-dispatch latency dominates and host BLAS +
+        argpartition is faster. Probe a real query at deploy time —
+        whether the factors arrive as device arrays (fresh train) or
+        host numpy (loaded blob) — and keep whichever layout serves
+        faster (threshold PIO_SERVE_DEVICE_MS, default 3 ms). No
+        reference analogue — MLlib serving is always JVM-host-side."""
         import os
         import time
 
         import jax
+
+        from predictionio_tpu.parallel import serve_dist
+
+        if serve_dist.serving_enabled():
+            try:
+                sharded = serve_dist.shard_factors(
+                    np.asarray(model.user_factors),
+                    np.asarray(model.item_factors))
+                return ALSModel(
+                    rank=model.rank,
+                    user_factors=sharded.user_shards,
+                    item_factors=sharded.item_shards,
+                    user_vocab=model.user_vocab,
+                    item_vocab=model.item_vocab,
+                    sharding=sharded)
+            except Exception:
+                import logging
+                logging.getLogger(
+                    "predictionio_tpu.recommendation").exception(
+                    "sharded serving layout failed; falling back to "
+                    "replicated serving")
 
         try:
             U = jax.device_put(np.asarray(model.user_factors))
@@ -318,10 +359,25 @@ class ALSAlgorithm(Algorithm):
         path (numpy factors) there are no device programs to build and
         deploy stays instant; ``declared=True`` (the `pio train` cache-
         artifact export) enumerates regardless, since the eventual
-        deploy may well pick the device path on its own hardware."""
+        deploy may well pick the device path on its own hardware.
+
+        A SHARDED model (prepare_serving chose the row-sharded layout)
+        enumerates the (bucket x k) sharded programs instead — bucket 1
+        always included for the inline path — so `post_warmup_recompiles
+        == 0` holds with sharding on. Sharded programs are mesh-
+        topology-specific, so the declared train-time export does not
+        enumerate them; the deploy-side prebuild owns them (the
+        persistent compile cache still amortizes them per machine)."""
+        from predictionio_tpu.serving import aot
+
+        sharding = getattr(model, "sharding", None)
+        if sharding is not None and not declared:
+            from predictionio_tpu.parallel import serve_dist
+
+            return serve_dist.sharded_program_specs(
+                sharding, buckets, aot.serving_ks(sharding.n_items))
         if not declared and isinstance(model.user_factors, np.ndarray):
             return ()
-        from predictionio_tpu.serving import aot
 
         n_users, rank = (int(d) for d in np.shape(model.user_factors))
         n_items = int(np.shape(model.item_factors)[0])
@@ -343,7 +399,17 @@ class ALSAlgorithm(Algorithm):
             # num <= 0 straight from request JSON: empty, not a device
             # error (lax.top_k rejects negative k)
             return PredictedResult(())
-        if isinstance(model.user_factors, np.ndarray):
+        sharding = getattr(model, "sharding", None)
+        if sharding is not None:
+            import jax
+
+            # inline sharded serve rides the same (bucket=1, k) program
+            # the batched path uses — sharded_program_specs always
+            # prebuilds bucket 1 for exactly this path
+            vals, idx = jax.device_get(sharding.topk(
+                np.asarray([user_ix], dtype=np.int32), k))
+            vals, idx = vals[0], idx[0]
+        elif isinstance(model.user_factors, np.ndarray):
             # host serving: one BLAS matvec + argpartition
             scores = model.item_factors @ model.user_factors[user_ix]
             vals, idx = topk.host_topk(scores, k)
@@ -381,7 +447,29 @@ class ALSAlgorithm(Algorithm):
         k = min(max(q.num for _qx, q, _ix in valid), len(model.item_vocab))
         ixs = np.asarray([ix for _qx, _q, ix in valid], dtype=np.int32)
         from predictionio_tpu.common import waterfall
-        if isinstance(model.user_factors, np.ndarray):
+        sharding = getattr(model, "sharding", None)
+        if sharding is not None:
+            from predictionio_tpu.serving.protocol import bucket_for
+            import jax
+
+            # sharded device path (parallel/serve_dist.py): the same
+            # pad-to-bucket prep, then ONE fused shard_map dispatch —
+            # per-device local top-k over each item shard + the
+            # all-gather merge — ending in the host transfer of the
+            # merged (bucket, k) result (KNOWN_ISSUES #3). Waterfall:
+            # `execute` is the per-shard drill-down inside `dispatch`;
+            # the shards note turns "execute is slow" into "it's the
+            # n-way sharded program", one hop from /debug/slow.json.
+            with waterfall.stage("pad"):
+                bucket = bucket_for(len(valid))
+                pix = np.zeros(bucket, dtype=np.int32)
+                pix[:len(valid)] = ixs
+            with waterfall.stage("execute"):
+                vals, idx = jax.device_get(sharding.topk(pix, k))
+            waterfall.note("shards", sharding.n_shards)
+            rows = [(vals[r, :min(q.num, k)], idx[r, :min(q.num, k)])
+                    for r, (_qx, q, _ix) in enumerate(valid)]
+        elif isinstance(model.user_factors, np.ndarray):
             # host: one BLAS gemm for the batch, per-row argpartition with
             # each query's own k (identical selection to predict())
             with waterfall.stage("execute"):

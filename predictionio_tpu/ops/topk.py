@@ -24,8 +24,27 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 NEG_INF = jnp.float32(-3.4e38)
+
+
+def stable_topk(scores: jnp.ndarray, k: int
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Deterministic top-k along the last axis: descending score, equal
+    scores broken by LOWEST index.
+
+    ``lax.top_k``'s tie order is backend-defined; a two-key ``lax.sort``
+    over (negated score, index) makes the selection total — every
+    (score, index) pair is unique — so the result is identical on every
+    backend and, crucially, recomposable from per-shard partial top-ks
+    (parallel/serve_dist.py): the sharded and replicated serving paths
+    can only be bit-identical if the tie rule is explicit. On TPU this
+    costs nothing — lax.top_k lowers to a full sort there anyway."""
+    idx = lax.broadcasted_iota(jnp.int32, scores.shape, scores.ndim - 1)
+    neg, sidx = lax.sort((-scores, idx), num_keys=2, dimension=-1)
+    # -(-x) is a bitwise round-trip for floats (two sign flips)
+    return -neg[..., :k], sidx[..., :k]
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -51,9 +70,11 @@ def topk_for_user(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused single-query serve: row gather + matvec + top_k in ONE
     dispatch, so a remote/tunneled device costs one round-trip per query
-    instead of four (gather, matmul, and two fetches)."""
+    instead of four (gather, matmul, and two fetches). Tie-deterministic
+    (stable_topk) so the inline path agrees bit-for-bit with the batched
+    and sharded kernels on tied scores."""
     q = jnp.take(user_factors, user_ix, axis=0)
-    return jax.lax.top_k(item_factors @ q, k)
+    return stable_topk(item_factors @ q, k)
 
 
 def host_masked_topk(factors, query_vec, mask, k: int, weights=None):
@@ -74,14 +95,33 @@ def host_topk(scores, k: int):
     """numpy argpartition top-K for host-side serving (small models or
     remote devices where per-query dispatch latency dominates). k <= 0
     (e.g. a negative `num` straight from request JSON) returns empty —
-    a negative argpartition slice would return nearly ALL entries."""
+    a negative argpartition slice would return nearly ALL entries.
+
+    Tie-deterministic like stable_topk: equal scores break by lowest
+    index. argpartition alone can't promise that — its selection at the
+    k-th-value boundary is arbitrary among tied entries — so entries
+    STRICTLY above the boundary keep the fast partitioned path and the
+    boundary ties are re-resolved from the full array (one vectorized
+    equality scan; flatnonzero yields them already index-ascending)."""
     import numpy as np
 
     k = min(k, scores.shape[-1])
     if k <= 0:
         return scores[:0], np.zeros((0,), dtype=np.int64)
-    idx = np.argpartition(-scores, k - 1)[:k]
-    idx = idx[np.argsort(-scores[idx], kind="stable")]
+    sel = np.argpartition(-scores, k - 1)[:k]
+    kth = scores[sel].min()          # the boundary value
+    if np.isnan(kth):
+        # non-finite scores (a poisoned model): keep the legacy
+        # selection so the NaNs PROPAGATE to the caller — the serving
+        # layer's non-finite gate must see them and 500; a
+        # deterministic-but-empty answer would mask the bad model
+        sel = sel[np.argsort(-scores[sel], kind="stable")]
+        return scores[sel], sel
+    strict = sel[scores[sel] > kth]
+    # lexsort: primary -score descending, secondary index ascending
+    strict = strict[np.lexsort((strict, -scores[strict]))]
+    ties = np.flatnonzero(scores == kth)[:k - strict.size]
+    idx = np.concatenate([strict, ties])
     return scores[idx], idx
 
 
@@ -112,9 +152,12 @@ def topk_for_users(
     B. Callers pad `user_ixs` up to a bucket size (serving/protocol.py)
     with any in-bounds index (an OOB pad index would gather NaN,
     KNOWN_ISSUES.md #5) and drop the padding rows from the result; this
-    compiles once per (bucket, k, shapes), not once per batch size."""
+    compiles once per (bucket, k, shapes), not once per batch size.
+    Tie-deterministic (stable_topk): equal scores break by lowest item
+    index — the contract the sharded serving path's cross-shard merge
+    (parallel/serve_dist.py) reproduces bit-for-bit."""
     Q = jnp.take(user_factors, user_ixs, axis=0)
-    return jax.lax.top_k(Q @ item_factors.T, k)
+    return stable_topk(Q @ item_factors.T, k)
 
 
 def host_masked_topk_batch(factors, query_vecs, masks, ks, weights=None):

@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.mesh import shard_map_compat
 from predictionio_tpu.ops.als import (
     ALSData, COOSide, _CSRB_B, _HOT_K, _HYBRID_DTYPE, _csrb_plan,
     _dense_hot_item, _dense_hot_user, _dense_min_count, _expand_X,
@@ -413,12 +414,11 @@ def _train_sharded(
     else:
         side_arrays = (su.self_idx, su.other_idx, su.rating, su.counts,
                        si.self_idx, si.other_idx, si.rating, si.counts)
-    sharded = jax.shard_map(
-        step_fn, mesh=mesh,
-        in_specs=tuple([P(axis)] * len(side_arrays))
+    sharded = shard_map_compat(
+        step_fn, mesh,
+        tuple([P(axis)] * len(side_arrays))
         + (P(axis, None), P(axis, None), P()),
-        out_specs=(P(None, None), P(None, None)),
-        check_vma=False,
+        (P(None, None), P(None, None)),
     )
     jitted = jax.jit(sharded)
 
@@ -528,13 +528,12 @@ def _train_sharded_hybrid(
 
         return lax.fori_loop(0, n_iters, one_iter, (U, V))
 
-    sharded = jax.shard_map(
-        step_fn, mesh=mesh,
-        in_specs=(P(axis, None), P(), P(axis), P(axis), P(axis), P(axis),
-                  P(axis), P(axis), P(axis), P(axis),
-                  P(axis, None), P(axis, None), P()),
-        out_specs=(P(None, None), P(None, None)),
-        check_vma=False,
+    sharded = shard_map_compat(
+        step_fn, mesh,
+        (P(axis, None), P(), P(axis), P(axis), P(axis), P(axis),
+         P(axis), P(axis), P(axis), P(axis),
+         P(axis, None), P(axis, None), P()),
+        (P(None, None), P(None, None)),
     )
     jitted = jax.jit(sharded)
 

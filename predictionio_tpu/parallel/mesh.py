@@ -9,7 +9,7 @@ host-side padding/partitioning for block-sharded kernels.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -62,6 +62,24 @@ def get_mesh(n_devices: Optional[int] = None,
                 "are visible")
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def shard_map_compat(f: Callable, mesh: Mesh, in_specs: Sequence[Any],
+                     out_specs: Any) -> Callable:
+    """``shard_map`` across the jax versions this repo runs on.
+
+    Newer jax exposes ``jax.shard_map`` (replication checking via
+    ``check_vma``); 0.4.x ships it as ``jax.experimental.shard_map``
+    with the ``check_rep`` spelling. Checking is disabled either way:
+    the kernels here use collectives (all_gather/psum) whose replication
+    the checker cannot always infer, exactly why als_dist always ran
+    with ``check_vma=False``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=tuple(in_specs),
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=tuple(in_specs),
+                      out_specs=out_specs, check_rep=False)
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, pad_value) -> np.ndarray:

@@ -1,0 +1,410 @@
+"""Sharded serving: answer top-k queries from row-sharded factor matrices.
+
+Training already block-shards factors over the mesh (parallel/als_dist.py)
+but the query server has served from a single-device replicated copy — the
+serving ceiling was one chip's HBM. This module removes it: both factor
+matrices are laid out ROW-SHARDED across a 1-D mesh and `topk_for_users`
+runs as a per-device local top-k over each item shard via shard_map, so a
+factor matrix that cannot fit one device serves fine across eight.
+
+Layout. Training's capacity-constrained LPT deal balances nnz because a
+half-step's cost is proportional to a row's rating count. Serving cost per
+item row is ONE rank-length dot product — uniform — so the same deal
+degenerates to "equal row counts per device": contiguous row blocks of
+``rows_dev = ceil(n / n_dev)`` rows (the exact padded address space the
+training deal uses, with uniform weights). Contiguous blocks additionally
+make shard-local -> global index recovery a single base-offset add AND
+preserve the tie-break order: within a shard, ascending local index IS
+ascending global index, so the per-shard top-k's lowest-local-index tie
+rule composes into the global lowest-index rule.
+
+Kernel (one fused device dispatch, same contract as ops.topk.topk_for_users):
+
+  1. user-vector gather: each device gathers the batch rows IT owns from
+     its user-factor shard and a psum replicates the (b, rank) query
+     block — the batch axis stays unsharded, so the micro-batcher and
+     padding buckets carry over unchanged;
+  2. local scores: (b, rank) x (rank, rows_dev) against the local item
+     shard. The contraction axis (rank) is never split, so every score
+     is the SAME float32 dot product the replicated kernel computes —
+     bit-identical values, not approximately-equal ones;
+  3. local top-k: two-key sort by (-score, global index), exactly
+     ops.topk.stable_topk's tie rule; padding rows are masked to
+     NEG_INF and carry global ids >= n_items so they sort last;
+  4. merge: ONE small all_gather of the k·n_dev candidates (~k·n_dev
+     floats per query) + a final two-key sort, on device.
+
+Merge strategy: all-gather, not host merge. The candidate set is tiny
+(k·n_dev values per query — hundreds of bytes), it rides the same ICI the
+training all-gathers use, and the result comes back as a plain (b, k)
+replicated array, so the caller contract, the AOT program registry, and
+the waterfall's `execute` stage (which must end in a real host transfer,
+KNOWN_ISSUES #3) are identical to the replicated path. A host merge would
+put an O(b·k·n_dev log) sort plus a second result reshape on the request
+thread and leak shard-count-dependent shapes into the protocol layer.
+
+Bit parity. For any model, batch, and k, the sharded result (values AND
+indices) is bit-identical to the replicated ``topk_for_users`` — ties
+break by lowest global index on both paths (ops/topk.py stable_topk is
+the shared contract). Asserted by tests/test_serve_dist.py at 1 and 8
+devices, including constructed score ties across shard boundaries, and
+by the multichip harness (__graft_entry__.dryrun_multichip).
+
+Mode resolution (`pio deploy --shard-serving auto/on/off`, env override
+``PIO_SERVE_SHARD``): "on" always shards over all visible devices (even a
+1-device mesh — the bench's overhead leg uses this); "off" never; "auto"
+shards only on a real multi-device accelerator mesh (the tier-1 virtual
+CPU devices share one host memory, so sharding there buys no HBM and
+costs collectives) and falls back to the replicated path on ``/reload``
+hot-swap — the swap window holds the old AND new model, and re-laying-out
+shards mid-swap risks exceeding per-device headroom exactly when the
+operator can least afford it; ``on`` remains the explicit opt-in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import os
+import threading
+from functools import partial
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.common import devicewatch, telemetry
+from predictionio_tpu.ops.topk import NEG_INF
+from predictionio_tpu.parallel.mesh import shard_map_compat
+
+logger = logging.getLogger("predictionio_tpu.serve_dist")
+
+#: the merge strategy this module implements (doctor/status surface it)
+MERGE_STRATEGY = "all_gather"
+
+#: mesh axis name for serving shards (distinct from training's "block"
+#: so the two subsystems' programs never alias)
+AXIS = "shard"
+
+
+# ---------------------------------------------------------------------------
+# mode resolution: ServerConfig.shard_serving + PIO_SERVE_SHARD
+# ---------------------------------------------------------------------------
+
+_scope = threading.local()
+
+
+def _normalize_mode(mode: str) -> str:
+    m = (mode or "auto").lower()
+    if m in ("0", "off"):
+        return "off"
+    if m in ("1", "on"):
+        return "on"
+    if m == "auto":
+        return "auto"
+    raise ValueError(f"shard-serving mode must be auto/on/off, got {mode!r}")
+
+
+def configured_mode(mode: Optional[str] = None) -> str:
+    """Effective mode: ``PIO_SERVE_SHARD`` wins over the config value
+    (the same override shape as PIO_AOT vs ServerConfig.aot)."""
+    env = os.environ.get("PIO_SERVE_SHARD", "")
+    if env:
+        return _normalize_mode(env)
+    if mode is not None:
+        return _normalize_mode(mode)
+    return _normalize_mode(getattr(_scope, "mode", "auto"))
+
+
+@contextlib.contextmanager
+def deploy_scope(mode: str, reload: bool = False):
+    """Install the deploy's shard-serving mode for the calling thread
+    (QueryAPI._load wraps prepare_serving in this): algorithms resolve
+    the mode without threading ServerConfig through every signature.
+    Validates eagerly so a bad config fails the deploy, not a query."""
+    _normalize_mode(mode)
+    prev = (getattr(_scope, "mode", None), getattr(_scope, "reload", None))
+    _scope.mode, _scope.reload = mode, bool(reload)
+    try:
+        yield
+    finally:
+        _scope.mode, _scope.reload = prev
+
+
+def _multi_device_platform() -> bool:
+    """A real multi-device accelerator mesh? Virtual CPU devices (the
+    tier-1 harness) share one host memory — auto stays replicated there
+    (tests monkeypatch this to exercise the auto path)."""
+    devs = jax.devices()
+    return len(devs) > 1 and devs[0].platform != "cpu"
+
+
+def serving_enabled(mode: Optional[str] = None) -> bool:
+    """Should prepare_serving lay this model out sharded?"""
+    m = configured_mode(mode)
+    if m == "off":
+        return False
+    if m == "on":
+        return True
+    # auto: multi-device accelerator only, and never mid-hot-swap
+    if getattr(_scope, "reload", False):
+        return False
+    return _multi_device_platform()
+
+
+# ---------------------------------------------------------------------------
+# the sharded serving kernel
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("k", "n_items", "rows_dev_u",
+                                   "rows_dev_i", "mesh"))
+def topk_for_users_sharded(
+    user_shards: jnp.ndarray,    # (n_dev * rows_dev_u, r) row-sharded
+    item_shards: jnp.ndarray,    # (n_dev * rows_dev_i, r) row-sharded
+    user_ixs: jnp.ndarray,       # (b,) int32 global user ids, replicated
+    *,
+    k: int,
+    n_items: int,
+    rows_dev_u: int,
+    rows_dev_i: int,
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Row-sharded batched top-k serve over ``mesh``: per-device local
+    top-k + one small all-gather merge; bit-identical (values, indices,
+    tie order) to ops.topk.topk_for_users on the replicated factors.
+    Compiles once per (mesh, shapes, bucket, k) — the AOT enumerator
+    (serving/aot.py via ALSAlgorithm.aot_serving_programs) prebuilds
+    every (bucket x k) program before /readyz flips ready."""
+    axis = mesh.axis_names[0]
+    b = user_ixs.shape[0]
+    k_local = min(int(k), int(rows_dev_i))
+
+    def step(U_blk, V_blk, ixs):
+        d = lax.axis_index(axis)
+        # 1. replicate the batch's user vectors: each device contributes
+        # the rows it owns, the psum fills in the rest with exact zeros
+        # (x + 0.0 == x), so Q is bit-identical to the replicated gather
+        loc = ixs - d * rows_dev_u
+        own = (loc >= 0) & (loc < rows_dev_u)
+        Q = jnp.take(U_blk, jnp.clip(loc, 0, rows_dev_u - 1), axis=0)
+        Q = lax.psum(Q * own[:, None].astype(U_blk.dtype), axis)
+        # 2. local scores; the contraction axis (rank) is unsplit, so
+        # each score is the same float32 dot product as replicated
+        scores = Q @ V_blk.T                          # (b, rows_dev_i)
+        gid = d * rows_dev_i + lax.broadcasted_iota(
+            jnp.int32, (b, rows_dev_i), 1)
+        scores = jnp.where(gid < n_items, scores, NEG_INF)
+        # 3. local top-k with the stable_topk tie rule (two-key sort by
+        # (-score, global index); contiguous blocks make local order ==
+        # global order, so shard ties break exactly like replicated)
+        neg, sid = lax.sort((-scores, gid), num_keys=2, dimension=-1)
+        # 4. merge: all-gather the k·n_dev candidates along the
+        # candidate axis + final two-key sort. Any global top-k element
+        # is inside its own shard's top-k_local, so the candidate set
+        # always covers the answer (k_local = rows_dev when k exceeds
+        # a shard, hence n_dev * k_local >= min(k, n_items) >= k).
+        cand_v = lax.all_gather(-neg[:, :k_local], axis, axis=1,
+                                tiled=True)
+        cand_g = lax.all_gather(sid[:, :k_local], axis, axis=1,
+                                tiled=True)
+        mneg, mg = lax.sort((-cand_v, cand_g), num_keys=2, dimension=-1)
+        return -mneg[:, :k], mg[:, :k]
+
+    return shard_map_compat(
+        step, mesh,
+        (P(axis, None), P(axis, None), P()),
+        (P(), P()),
+    )(user_shards, item_shards, user_ixs)
+
+
+# ---------------------------------------------------------------------------
+# layout: canonical factors -> row-sharded device arrays
+# ---------------------------------------------------------------------------
+
+def _rows_dev(n: int, n_dev: int) -> int:
+    return max(-(-n // n_dev), 1)
+
+
+def _shard_rows(arr: np.ndarray, rows_dev: int, spec: NamedSharding):
+    """Pad axis 0 to rows_dev * n_dev with zero rows and place each
+    contiguous block on its device (every process holds the full host
+    array, so each one donates its addressable shards — the same
+    strategy als_dist._shard_put uses)."""
+    n_dev = spec.mesh.devices.size
+    n_pad = rows_dev * n_dev
+    if arr.shape[0] != n_pad:
+        out = np.zeros((n_pad,) + arr.shape[1:], dtype=arr.dtype)
+        out[:arr.shape[0]] = arr
+        arr = out
+    return jax.make_array_from_callback(arr.shape, spec,
+                                        lambda idx: arr[idx])
+
+
+@dataclasses.dataclass
+class ShardedFactors:
+    """One model's factors laid out for sharded serving, plus the jit
+    statics its programs need. ``topk`` is the drop-in replacement for
+    the replicated ``topk_for_users(U, V, ixs, k)`` call."""
+    mesh: Mesh
+    n_users: int
+    n_items: int
+    rank: int
+    rows_dev_u: int
+    rows_dev_i: int
+    user_shards: Any
+    item_shards: Any
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def per_shard_bytes(self) -> int:
+        """Per-device factor bytes (padded rows included) — the number
+        the HBM-ceiling story is about: total/n_dev instead of total."""
+        itemsize = 4  # float32 serving factors
+        return (self.rows_dev_u + self.rows_dev_i) * self.rank * itemsize
+
+    def topk(self, user_ixs, k: int):
+        ixs = np.asarray(user_ixs, dtype=np.int32)
+        return topk_for_users_sharded(
+            self.user_shards, self.item_shards, ixs,
+            k=int(k), n_items=self.n_items,
+            rows_dev_u=self.rows_dev_u, rows_dev_i=self.rows_dev_i,
+            mesh=self.mesh)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "shards": self.n_shards,
+            "merge": MERGE_STRATEGY,
+            "rowsPerShard": {"users": self.rows_dev_u,
+                             "items": self.rows_dev_i},
+            "perShardFactorBytes": self.per_shard_bytes(),
+        }
+
+
+def shard_factors(user_factors, item_factors,
+                  n_shards: Optional[int] = None,
+                  mesh: Optional[Mesh] = None) -> ShardedFactors:
+    """Lay a model's factor matrices out row-sharded for serving.
+
+    Default mesh: all visible devices on a fresh 1-D "shard" axis.
+    Records the ``pio_serve_shards`` gauge and the /debug/device.json
+    sharding block so `pio doctor` can see the layout."""
+    if mesh is None:
+        devices = jax.devices()
+        if n_shards is not None:
+            if n_shards > len(devices):
+                raise ValueError(
+                    f"requested {n_shards} serving shards but only "
+                    f"{len(devices)} devices are visible")
+            devices = devices[:n_shards]
+        mesh = Mesh(np.asarray(devices), (AXIS,))
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    U = np.asarray(user_factors, dtype=np.float32)
+    V = np.asarray(item_factors, dtype=np.float32)
+    n_users, rank = U.shape
+    n_items = V.shape[0]
+    rows_u = _rows_dev(n_users, n_dev)
+    rows_i = _rows_dev(n_items, n_dev)
+    row_spec = NamedSharding(mesh, P(axis, None))
+    sharded = ShardedFactors(
+        mesh=mesh, n_users=n_users, n_items=n_items, rank=rank,
+        rows_dev_u=rows_u, rows_dev_i=rows_i,
+        user_shards=_shard_rows(U, rows_u, row_spec),
+        item_shards=_shard_rows(V, rows_i, row_spec))
+    record_state(sharded.summary())
+    logger.info("factors sharded for serving: %d users + %d items x r=%d "
+                "over %d device(s), %.1f MiB/shard", n_users, n_items,
+                rank, n_dev, sharded.per_shard_bytes() / 2**20)
+    return sharded
+
+
+def record_state(summary: Optional[Dict[str, Any]]) -> None:
+    """Publish (or with None, clear) the live sharded-serving layout:
+    the ``pio_serve_shards`` gauge + the /debug/device.json sharding
+    block `pio doctor`'s sharding line reads."""
+    telemetry.registry().gauge(
+        "pio_serve_shards",
+        "Serving shards the deployed factor matrices are split over "
+        "(0 = replicated single-device serving)").labels().set(
+            float(summary.get("shards", 0)) if summary else 0.0)
+    devicewatch.note_sharding(summary)
+
+
+# ---------------------------------------------------------------------------
+# AOT program enumeration (serving/aot.py plugs these into prebuild)
+# ---------------------------------------------------------------------------
+
+def sharded_program_specs(sharded: ShardedFactors, buckets: Iterable[int],
+                          ks: Iterable[int]) -> List[Any]:
+    """One ProgramSpec per (bucket x k) sharded serving program, with
+    prime closures over the live sharded arrays so deploy prebuild
+    warms the exact jit dispatch cache the flush path hits. Bucket 1 is
+    always included: the inline (batching-off) path serves single
+    queries through the same sharded kernel at b=1."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    out: List[Any] = []
+    all_buckets = sorted({1, *(int(b) for b in buckets)})
+    for b in all_buckets:
+        for k in ks:
+            out.append(ProgramSpec(
+                name="topk_for_users_sharded",
+                key=("topk_for_users_sharded", sharded.n_users,
+                     sharded.n_items, sharded.rank, sharded.n_shards,
+                     int(b), int(k)),
+                lower=_sharded_lowerer(sharded, int(b), int(k)),
+                prime=_sharded_primer(sharded, int(b), int(k))))
+    return out
+
+
+def _sharded_lowerer(sharded: ShardedFactors, bucket: int, k: int):
+    def lower():
+        axis = sharded.mesh.axis_names[0]
+        row = NamedSharding(sharded.mesh, P(axis, None))
+        rep = NamedSharding(sharded.mesh, P())
+        n_dev = sharded.n_shards
+        return topk_for_users_sharded.lower(
+            jax.ShapeDtypeStruct(
+                (sharded.rows_dev_u * n_dev, sharded.rank),
+                np.float32, sharding=row),
+            jax.ShapeDtypeStruct(
+                (sharded.rows_dev_i * n_dev, sharded.rank),
+                np.float32, sharding=row),
+            jax.ShapeDtypeStruct((bucket,), np.int32, sharding=rep),
+            k=k, n_items=sharded.n_items,
+            rows_dev_u=sharded.rows_dev_u,
+            rows_dev_i=sharded.rows_dev_i, mesh=sharded.mesh)
+    return lower
+
+
+def _sharded_primer(sharded: ShardedFactors, bucket: int, k: int):
+    def prime():
+        # index 0 is always a real user row; device_get ends the
+        # dispatch in a real host transfer (KNOWN_ISSUES #3)
+        ix = np.zeros((bucket,), dtype=np.int32)
+        jax.device_get(sharded.topk(ix, k))
+    return prime
+
+
+# ---------------------------------------------------------------------------
+# AOT registry entry (the tier-1 lint in tests/test_aot.py checks every
+# @jax.jit def in this module against the registry)
+# ---------------------------------------------------------------------------
+
+def _register() -> None:
+    from predictionio_tpu.serving import aot
+    aot.register_jit(
+        "topk_for_users_sharded", topk_for_users_sharded, kind="serving",
+        note="enumerated per (bucket, k) by sharded_program_specs when "
+             "prepare_serving chose the sharded layout; mesh-topology-"
+             "specific, so the train-time declared export skips it and "
+             "the deploy-side prebuild owns it")
+
+
+_register()

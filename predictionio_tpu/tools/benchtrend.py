@@ -79,6 +79,12 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # bench's own waterfall leg under BENCH_STRICT_EXTRAS=1
     ("waterfall_overhead_p99_pct", "down", False),
     ("waterfall_on_p99_ms", "down", False),
+    # sharded-serving era (parallel/serve_dist.py): the row-sharded
+    # top-k path's p99 and its overhead vs the replicated path —
+    # hard-gated at <= 10% by the bench's serve-sharded leg under
+    # BENCH_STRICT_EXTRAS=1, trended here
+    ("serve_sharded_p99_ms", "down", False),
+    ("serve_sharded_overhead_pct", "down", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round

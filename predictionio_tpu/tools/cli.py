@@ -215,6 +215,7 @@ def cmd_deploy(args) -> int:
         aot_threads=args.aot_threads,
         slo_availability=args.slo_availability,
         slo_latency_ms=args.slo_latency_ms,
+        shard_serving=args.shard_serving,
     )
     if args.compile_cache:
         os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
@@ -628,6 +629,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--profile-dir", default="",
                     help="directory for POST /debug/profile capture "
                          "artifacts (sets PIO_PROFILE_DIR)")
+    sp.add_argument("--shard-serving", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="row-shard the deployed factor matrices over "
+                         "the device mesh and serve top-k from "
+                         "per-device shards (parallel/serve_dist.py; "
+                         "bit-identical results, per-device HBM drops "
+                         "to total/n_dev; auto = multi-device "
+                         "accelerator meshes only; PIO_SERVE_SHARD "
+                         "overrides)")
     sp.add_argument("--slo-availability", type=float, default=None,
                     help="availability SLO target, e.g. 0.999 "
                          "(default PIO_SLO_AVAILABILITY or 0.999)")

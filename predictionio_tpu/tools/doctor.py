@@ -18,6 +18,8 @@ waterfall's slowest sampled request:
       recompiles  ok    0 post-warmup XLA recompiles
       aot         ok    5 programs prebuilt (5 compiled, 0 cached — 0%
                         hit — in 0.3 s), ready in 0.4 s
+      sharding    ok    8 shard(s), all_gather merge, 2.1 MiB
+                        factors/shard, min per-device HBM headroom 84%
       hbm         --    no device memory stats (CPU / unsupported)
       traces      ok    512 spans buffered
     VERDICT: OK
@@ -352,6 +354,46 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
                            "target (cold cache? missing artifact?)"))
         else:
             checks.append(("aot", OK, detail))
+
+    # sharded serving (parallel/serve_dist.py) -------------------------
+    shards = metric_max(samples, "pio_serve_shards")
+    shard_info = device.get("sharding") or {}
+    if not (shards or 0) and not shard_info:
+        checks.append(("sharding", NA,
+                       _OPT_IN.format("the serving shard layout")
+                       if telemetry_off
+                       else "replicated serving (factors on one device)"))
+    else:
+        n = int(shards or shard_info.get("shards", 0) or 0)
+        merge = shard_info.get("merge", "?")
+        # per-device headroom: the sharded layout's failure mode is ONE
+        # shard running out, so the min across devices is the verdict
+        per_dev: Dict[str, Dict[str, float]] = {}
+        for name, field in (("pio_hbm_bytes_in_use", "use"),
+                            ("pio_hbm_bytes_limit", "limit")):
+            for labels, v in samples.get(name, []):
+                m = re.search(r'device="([^"]+)"', labels)
+                if m:
+                    per_dev.setdefault(m.group(1), {})[field] = v
+        headrooms = [1.0 - d["use"] / d["limit"]
+                     for d in per_dev.values()
+                     if d.get("limit") and "use" in d]
+        detail = f"{n} shard(s), {merge} merge"
+        psb = shard_info.get("perShardFactorBytes")
+        if psb:
+            detail += f", {psb / 2**20:.1f} MiB factors/shard"
+        if headrooms:
+            min_head = min(headrooms)
+            detail += (f", min per-device HBM headroom "
+                       f"{min_head * 100:.0f}%")
+            state = WARN if min_head < 0.10 else OK
+            if state is WARN:
+                detail += (" — a shard within 10% of HBM; grow the "
+                           "mesh or shrink the model")
+        else:
+            detail += ", no per-device memory stats (CPU / unsupported)"
+            state = OK
+        checks.append(("sharding", state, detail))
 
     # HBM headroom -----------------------------------------------------
     in_use = metric_sum(samples, "pio_hbm_bytes_in_use")

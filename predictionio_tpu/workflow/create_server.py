@@ -102,6 +102,14 @@ class ServerConfig:
     slo_availability: Optional[float] = None
     slo_latency_ms: Optional[float] = None
     slo_latency_target: Optional[float] = None
+    #: sharded serving (parallel/serve_dist.py): "on" row-shards the
+    #: deployed factor matrices over every visible device and serves
+    #: top-k from per-device local shards (bit-identical results;
+    #: per-device HBM drops to total/n_dev); "auto" does so only on a
+    #: real multi-device accelerator mesh and falls back to replicated
+    #: on /reload hot-swap; "off" keeps the replicated path.
+    #: PIO_SERVE_SHARD overrides.
+    shard_serving: str = "auto"
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -223,6 +231,7 @@ class QueryAPI:
         #: prebuild done) — the metric the <10 s warm-replica gate reads
         self.time_to_ready_s: Optional[float] = None
         self._aot_state: Optional[Dict[str, Any]] = None
+        self._shard_state: Optional[Dict[str, Any]] = None
         reg = telemetry.registry()
         self._m_time_to_ready = reg.gauge(
             "pio_time_to_ready_seconds",
@@ -265,8 +274,21 @@ class QueryAPI:
         models = prepare_deploy(
             self.ctx, engine, engine_params, instance.id, models,
             algorithms=algorithms)
-        models = [a.prepare_serving(m)
-                  for a, m in zip(algorithms, models)]
+        # shard-serving scope (parallel/serve_dist.py): each algorithm's
+        # prepare_serving resolves the deploy's mode inside it. A reload
+        # is flagged so "auto" falls back to the replicated layout
+        # during hot-swap (the swap window holds BOTH models; "on"
+        # stays sharded — the operator's explicit call).
+        from predictionio_tpu.parallel import serve_dist
+        is_reload = getattr(self, "engine_instance", None) is not None
+        with serve_dist.deploy_scope(self.config.shard_serving,
+                                     reload=is_reload):
+            models = [a.prepare_serving(m)
+                      for a, m in zip(algorithms, models)]
+        shard_state = next(
+            (m.sharding.summary() for m in models
+             if getattr(m, "sharding", None) is not None), None)
+        serve_dist.record_state(shard_state)
         aot_state, serve_buckets = self._prebuild_aot(
             instance, algorithms, models)
         batcher = self._make_batcher(algorithms, models, serving,
@@ -279,6 +301,7 @@ class QueryAPI:
             self.models = models
             self.serving = serving
             self._aot_state = aot_state
+            self._shard_state = shard_state
             old_batcher, self._batcher = self._batcher, batcher
         if old_batcher is not None:   # reload: drain in-flight, then retire
             old_batcher.close()
@@ -504,6 +527,10 @@ class QueryAPI:
                           "timeToReadyS": (round(self.time_to_ready_s, 3)
                                            if self.time_to_ready_s
                                            is not None else None)}
+        if getattr(self, "_shard_state", None) is not None:
+            # only when sharded serving is live: replicated deploys keep
+            # the exact legacy key set (wire parity)
+            out["sharding"] = {"enabled": True, **self._shard_state}
         return out
 
     def _readyz(self) -> Response:

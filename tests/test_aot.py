@@ -128,18 +128,27 @@ def test_every_serving_path_jit_is_registered():
     it is a test failure instead."""
     import importlib
 
-    serving_modules = [("ops/topk.py", "predictionio_tpu.ops.topk")]
+    serving_modules = [
+        ("ops/topk.py", "predictionio_tpu.ops.topk"),
+        # the sharded serving kernel lives with its layout machinery in
+        # parallel/ but is very much on the serving path
+        ("parallel/serve_dist.py", "predictionio_tpu.parallel.serve_dist"),
+    ]
     serving_dir = os.path.join(PKG, "serving")
     for f in sorted(os.listdir(serving_dir)):
         if f.endswith(".py") and f != "__init__.py":
             serving_modules.append(
                 (f"serving/{f}", f"predictionio_tpu.serving.{f[:-3]}"))
+    # import every linted module FIRST: registration happens at import
+    # time (serve_dist registers its kernel in its own module body)
+    modules = {rel: importlib.import_module(modname)
+               for rel, modname in serving_modules}
     registered_fns = {id(r.fn) for r in aot._REGISTRY.values()}
     # jit wrappers may nest (e.g. devicewatch.watch_jit); compare on
     # the module attribute object itself
     offenders = []
     for rel, modname in serving_modules:
-        mod = importlib.import_module(modname)
+        mod = modules[rel]
         for name in _jit_decorated_defs(os.path.join(PKG, rel)):
             fn = getattr(mod, name, None)
             if fn is None:

@@ -1,0 +1,381 @@
+"""Sharded serving (parallel/serve_dist.py) on the 8-device virtual mesh.
+
+The acceptance surface of ISSUE 8: sharded and replicated serving return
+BIT-identical (values, indices) top-k — at 1 device and at 8 simulated
+devices, including constructed score ties across shard boundaries — the
+mode knob resolves config/env/auto correctly (auto falls back on /reload
+hot-swap), the deployed server's wire bytes are unchanged by sharding,
+and the sharded (bucket x k) programs are AOT-prebuilt so
+post_warmup_recompiles stays 0 with sharding on.
+"""
+
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.common import devicewatch, telemetry
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+)
+from predictionio_tpu.ops import topk
+from predictionio_tpu.parallel import serve_dist
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    serve_dist.record_state(None)
+    telemetry.set_enabled(None)
+
+
+def _factors(n_users=13, n_items=45, rank=5, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_users, rank)).astype(np.float32)
+    V = rng.normal(size=(n_items, rank)).astype(np.float32)
+    return U, V
+
+
+def _replicated(U, V, ixs, k):
+    return jax.device_get(topk.topk_for_users(
+        jnp.asarray(U), jnp.asarray(V), np.asarray(ixs, np.int32), k=k))
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: bit-identical to the replicated path
+# ---------------------------------------------------------------------------
+
+def test_sharded_matches_replicated_bit_identical():
+    """8 shards, n_items NOT divisible by the device count (padding rows
+    on the last shard), k spanning below/at/above rows-per-shard."""
+    U, V = _factors()
+    sharded = serve_dist.shard_factors(U, V)
+    assert sharded.n_shards == 8
+    ixs = np.array([0, 5, 12, 0, 7], dtype=np.int32)
+    for k in (1, 3, 6, 20, 45):     # rows_dev_i = 6: 20 and 45 exceed it
+        sv, si = jax.device_get(sharded.topk(ixs, k))
+        rv, ri = _replicated(U, V, ixs, k)
+        # bit-identical, not allclose: view as int32 so -0.0 vs 0.0 or a
+        # ulp of drift would fail loudly
+        np.testing.assert_array_equal(sv.view(np.int32),
+                                      rv.view(np.int32), err_msg=f"k={k}")
+        np.testing.assert_array_equal(si, ri, err_msg=f"k={k}")
+
+
+def test_sharded_single_device_mesh_parity():
+    U, V = _factors(seed=1)
+    sharded = serve_dist.shard_factors(U, V, n_shards=1)
+    assert sharded.n_shards == 1
+    ixs = np.array([2, 2, 9], dtype=np.int32)
+    sv, si = jax.device_get(sharded.topk(ixs, 7))
+    rv, ri = _replicated(U, V, ixs, 7)
+    np.testing.assert_array_equal(sv.view(np.int32), rv.view(np.int32))
+    np.testing.assert_array_equal(si, ri)
+
+
+def test_tie_across_shard_boundaries():
+    """Duplicated item rows in different shards score identically; both
+    paths must rank the clones lowest-global-index first."""
+    U, V = _factors(n_items=40, seed=2)
+    V[39] = V[3]      # last shard
+    V[20] = V[3]      # middle shard
+    sharded = serve_dist.shard_factors(U, V)
+    ixs = np.arange(8, dtype=np.int32)
+    sv, si = jax.device_get(sharded.topk(ixs, 40))
+    rv, ri = _replicated(U, V, ixs, 40)
+    np.testing.assert_array_equal(sv.view(np.int32), rv.view(np.int32))
+    np.testing.assert_array_equal(si, ri)
+    # the rule itself, not just parity: clone 3 outranks 20 outranks 39
+    for row in si:
+        pos = [int(np.flatnonzero(row == c)[0]) for c in (3, 20, 39)]
+        assert pos == sorted(pos), pos
+
+
+def test_all_equal_scores_rank_by_global_index():
+    """Total tie (zero item factors): the top-k must be exactly the k
+    lowest global indices on both paths — the strongest cross-shard
+    tie-break case there is."""
+    U, _ = _factors(seed=3)
+    V = np.zeros((37, U.shape[1]), dtype=np.float32)
+    sharded = serve_dist.shard_factors(U, V)
+    ixs = np.array([1, 4], dtype=np.int32)
+    sv, si = jax.device_get(sharded.topk(ixs, 9))
+    rv, ri = _replicated(U, V, ixs, 9)
+    np.testing.assert_array_equal(si, np.tile(np.arange(9), (2, 1)))
+    np.testing.assert_array_equal(si, ri)
+    np.testing.assert_array_equal(sv.view(np.int32), rv.view(np.int32))
+
+
+def test_more_users_and_items_than_one_shard_row():
+    """n_users < n_dev (some shards own no real user rows) still gathers
+    correctly through the psum."""
+    U, V = _factors(n_users=3, n_items=11, seed=4)
+    sharded = serve_dist.shard_factors(U, V)
+    ixs = np.array([0, 1, 2, 2], dtype=np.int32)
+    sv, si = jax.device_get(sharded.topk(ixs, 11))
+    rv, ri = _replicated(U, V, ixs, 11)
+    np.testing.assert_array_equal(sv.view(np.int32), rv.view(np.int32))
+    np.testing.assert_array_equal(si, ri)
+
+
+# ---------------------------------------------------------------------------
+# mode resolution
+# ---------------------------------------------------------------------------
+
+def test_mode_resolution(monkeypatch):
+    monkeypatch.delenv("PIO_SERVE_SHARD", raising=False)
+    # bare defaults: auto + virtual CPU devices -> replicated
+    assert serve_dist.configured_mode() == "auto"
+    assert not serve_dist.serving_enabled()
+    with serve_dist.deploy_scope("on"):
+        assert serve_dist.serving_enabled()
+    with serve_dist.deploy_scope("off"):
+        assert not serve_dist.serving_enabled()
+    # env wins over the config scope (the PIO_AOT override shape)
+    monkeypatch.setenv("PIO_SERVE_SHARD", "0")
+    with serve_dist.deploy_scope("on"):
+        assert not serve_dist.serving_enabled()
+    monkeypatch.setenv("PIO_SERVE_SHARD", "1")
+    with serve_dist.deploy_scope("off"):
+        assert serve_dist.serving_enabled()
+
+
+def test_auto_falls_back_on_reload_and_cpu(monkeypatch):
+    monkeypatch.delenv("PIO_SERVE_SHARD", raising=False)
+    # auto on a "real" multi-device mesh: sharded...
+    monkeypatch.setattr(serve_dist, "_multi_device_platform", lambda: True)
+    with serve_dist.deploy_scope("auto"):
+        assert serve_dist.serving_enabled()
+    # ...but not during a /reload hot-swap
+    with serve_dist.deploy_scope("auto", reload=True):
+        assert not serve_dist.serving_enabled()
+    # "on" stays sharded even across a reload (explicit operator call)
+    with serve_dist.deploy_scope("on", reload=True):
+        assert serve_dist.serving_enabled()
+    # virtual CPU devices: auto stays replicated
+    monkeypatch.setattr(serve_dist, "_multi_device_platform",
+                        lambda: False)
+    with serve_dist.deploy_scope("auto"):
+        assert not serve_dist.serving_enabled()
+
+
+def test_bad_mode_rejected():
+    with pytest.raises(ValueError):
+        with serve_dist.deploy_scope("sideways"):
+            pass
+    with pytest.raises(ValueError):
+        serve_dist.configured_mode("sideways")
+
+
+# ---------------------------------------------------------------------------
+# deployed server: wire parity, status surface, AOT coverage
+# ---------------------------------------------------------------------------
+
+def _train_engine(storage, n_items=9, rank=3):
+    app_id = storage.get_meta_data_apps().insert(App(0, "ShardApp"))
+    storage.get_events().init(app_id)
+    events = []
+    for u in range(8):
+        for i in range(n_items):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": 5.0 if (u % 3) == (i % 3) else 1.5}),
+                event_time=dt.datetime(2021, 2, 3, 0, (u + i) % 60,
+                                       tzinfo=dt.timezone.utc)))
+    storage.get_events().insert_batch(events, app_id)
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="ShardApp"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=rank, numIterations=2,
+                                       lambda_=0.05, seed=5)),))
+    run_train(WorkflowContext(storage=storage), engine, ep,
+              engine_factory="shard-test",
+              params_json={
+                  "datasource": {"params": {"appName": "ShardApp"}},
+                  "algorithms": [{"name": "als", "params": {
+                      "rank": rank, "numIterations": 2,
+                      "lambda": 0.05, "seed": 5}}]})
+    return engine
+
+
+def _post(api, user, num=5):
+    status, body = api.handle(
+        "POST", "/queries.json",
+        body=json.dumps({"user": user, "num": num}).encode())
+    assert status == 200, body
+    return json.dumps(body, sort_keys=True)
+
+
+def test_query_api_sharded_wire_parity(memory_storage, monkeypatch):
+    """A sharded deploy answers byte-for-byte what the replicated deploy
+    answers, exposes its layout on GET / + the gauge, and keeps the
+    legacy key set when replicated."""
+    # pin the replicated leg to the device path: the parity contract is
+    # sharded-vs-replicated DEVICE kernels (host BLAS accumulates in a
+    # different order) and the probe must not flip it on a slow CI host
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")
+    engine = _train_engine(memory_storage)
+    queries = [("u1", 5), ("u3", 9), ("nobody", 5), ("u7", 1)]
+
+    api_off = QueryAPI(storage=memory_storage, engine=engine,
+                       config=ServerConfig(batching="on",
+                                           shard_serving="off"))
+    try:
+        off_answers = [_post(api_off, u, n) for u, n in queries]
+        off_status = api_off.handle("GET", "/")[1]
+        assert "sharding" not in off_status     # legacy key set intact
+    finally:
+        api_off.close()
+
+    api_on = QueryAPI(storage=memory_storage, engine=engine,
+                      config=ServerConfig(batching="on",
+                                          shard_serving="on"))
+    try:
+        on_answers = [_post(api_on, u, n) for u, n in queries]
+        on_status = api_on.handle("GET", "/")[1]
+        sh = on_status["sharding"]
+        assert sh["enabled"] and sh["shards"] == 8
+        assert sh["merge"] == serve_dist.MERGE_STRATEGY
+        gauge = telemetry.registry().gauge(
+            "pio_serve_shards", "x").labels()
+        assert gauge.value == 8.0
+        model = api_on.models[0]
+        assert model.sharding is not None
+    finally:
+        api_on.close()
+    assert on_answers == off_answers
+
+
+def test_reload_falls_back_to_replicated_on_auto(memory_storage,
+                                                 monkeypatch):
+    monkeypatch.delenv("PIO_SERVE_SHARD", raising=False)
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "1e9")
+    monkeypatch.setattr(serve_dist, "_multi_device_platform",
+                        lambda: True)
+    engine = _train_engine(memory_storage, n_items=8)
+    api = QueryAPI(storage=memory_storage, engine=engine,
+                   config=ServerConfig(batching="on",
+                                       shard_serving="auto"))
+    try:
+        assert api.handle("GET", "/")[1]["sharding"]["shards"] == 8
+        before = _post(api, "u2", 4)
+        api._reload()                       # hot-swap: auto -> replicated
+        assert "sharding" not in api.handle("GET", "/")[1]
+        assert getattr(api.models[0], "sharding", None) is None
+        assert _post(api, "u2", 4) == before
+        # the gauge reflects the fallback
+        assert telemetry.registry().gauge(
+            "pio_serve_shards", "x").labels().value == 0.0
+    finally:
+        api.close()
+
+
+def test_sharded_programs_prebuilt_no_post_warmup_recompiles(
+        memory_storage):
+    """With sharding on, every (bucket x k) sharded program is primed
+    before ready: a post-AOT serving burst must compile NOTHING."""
+    telemetry.set_enabled(True)
+    devicewatch.install()
+    devicewatch.reset_watchdog()
+    engine = _train_engine(memory_storage, n_items=10, rank=4)
+    api = QueryAPI(storage=memory_storage, engine=engine,
+                   config=ServerConfig(batching="on",
+                                       shard_serving="on"))
+    try:
+        assert devicewatch.serving_warmup_done()    # AOT marked it
+        before = devicewatch.post_warmup_recompiles()
+        for q in range(6):
+            _post(api, f"u{q}", 10)                 # k=10 clamps to 10
+        assert devicewatch.post_warmup_recompiles() == before
+    finally:
+        api.close()
+        devicewatch.reset_watchdog()
+
+
+def test_sharded_program_specs_cover_inline_bucket():
+    U, V = _factors(seed=6)
+    sharded = serve_dist.shard_factors(U, V)
+    specs = serve_dist.sharded_program_specs(sharded, (4, 16), (10,))
+    buckets = sorted({s.key[-2] for s in specs})
+    assert buckets == [1, 4, 16]      # bucket 1 forced in for inline
+    assert all(s.name == "topk_for_users_sharded" for s in specs)
+    # a spec is genuinely AOT-compilable from declared (sharded) shapes
+    specs[0].build()
+
+
+def test_hbm_ceiling_demo_shards_past_one_device_budget(monkeypatch):
+    """The bench's HBM-ceiling leg on the 8-device mesh: a factor matrix
+    sized past one device's (demonstration) budget serves only sharded —
+    replicated placement exceeds the budget, each shard fits, and the
+    sharded top-k actually answers."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SHARD_BUDGET_MB", "1")
+    out = bench._shard_hbm_ceiling_demo()
+    assert "skipped" not in out
+    assert out["n_devices"] == 8
+    assert out["factor_bytes"] > out["budget_bytes"]
+    assert not out["replicated_fits_budget"]
+    assert out["sharded_fits_budget"]
+    assert out["per_shard_bytes"] < out["factor_bytes"] // 4
+    assert out["sharded_served_ok"]
+
+
+# ---------------------------------------------------------------------------
+# doctor: the sharding line
+# ---------------------------------------------------------------------------
+
+def _scrape_stub(metrics_text, device_body):
+    blank = {"status": None, "body": ""}
+    return {
+        "url": "http://x", "healthz": {"status": 200, "body": "{}"},
+        "readyz": {"status": 200, "body": '{"status": "ready"}'},
+        "metrics": {"status": 200, "body": metrics_text},
+        "traces": {"status": 200, "body": '{"spanCount": 0}'},
+        "device": {"status": 200, "body": json.dumps(device_body)},
+        "slow": dict(blank),
+    }
+
+
+def test_doctor_sharding_line_states():
+    from predictionio_tpu.tools import doctor
+
+    dev = {"telemetry": True,
+           "sharding": {"shards": 8, "merge": "all_gather",
+                        "perShardFactorBytes": 2 * 2**20}}
+    # healthy headroom on every device
+    metrics = ("pio_serve_shards 8\n"
+               'pio_hbm_bytes_in_use{device="tpu:0"} 100\n'
+               'pio_hbm_bytes_limit{device="tpu:0"} 1000\n'
+               'pio_hbm_bytes_in_use{device="tpu:1"} 300\n'
+               'pio_hbm_bytes_limit{device="tpu:1"} 1000\n')
+    checks = {c: (s, d) for c, s, d in
+              doctor.diagnose(_scrape_stub(metrics, dev))}
+    state, detail = checks["sharding"]
+    assert state == doctor.OK
+    assert "8 shard(s), all_gather merge" in detail
+    assert "headroom 70%" in detail
+    # one shard within 10% of HBM -> WARN names the fix
+    metrics_hot = metrics.replace(
+        'pio_hbm_bytes_in_use{device="tpu:1"} 300',
+        'pio_hbm_bytes_in_use{device="tpu:1"} 950')
+    state, detail = {c: (s, d) for c, s, d in doctor.diagnose(
+        _scrape_stub(metrics_hot, dev))}["sharding"]
+    assert state == doctor.WARN and "within 10%" in detail
+    # replicated daemon: informational NA-ish OK line, never noisy
+    state, detail = {c: (s, d) for c, s, d in doctor.diagnose(
+        _scrape_stub("", {"telemetry": True}))}["sharding"]
+    assert state == doctor.NA and "replicated" in detail

@@ -91,7 +91,7 @@ def test_no_wall_clock_time_in_package():
 _TIMED_MODULES = (
     "common/telemetry.py", "common/tracing.py", "common/devicewatch.py",
     "common/waterfall.py", "common/profiling.py", "common/slo.py",
-    "serving/batcher.py", "serving/aot.py",
+    "serving/batcher.py", "serving/aot.py", "parallel/serve_dist.py",
     "workflow/context.py", "workflow/core_workflow.py",
     "workflow/create_server.py", "data/store.py", "ops/staging.py",
     "models/recommendation/als_algorithm.py",

@@ -40,6 +40,75 @@ def test_cosine_topk_scale_invariant():
     np.testing.assert_allclose(float(np.asarray(vals)[0]), 1.0, rtol=1e-5)
 
 
+def test_topk_for_users_tie_breaks_lowest_index():
+    """Equal scores break by LOWEST item index (stable_topk): the
+    contract the sharded serving merge reproduces bit-for-bit."""
+    U = np.eye(2, dtype=np.float32)
+    # items 1, 3, 4 score identically for user 0; 0 and 2 for user 1
+    V = np.array([[0.0, 1.0], [2.0, 0.0], [0.0, 1.0],
+                  [2.0, 0.0], [2.0, 0.0]], dtype=np.float32)
+    vals, idx = topk.topk_for_users(U, V, np.array([0, 1], np.int32), k=4)
+    np.testing.assert_array_equal(np.asarray(idx)[0], [1, 3, 4, 0])
+    np.testing.assert_array_equal(np.asarray(idx)[1], [0, 2, 1, 3])
+    np.testing.assert_allclose(np.asarray(vals)[0], [2, 2, 2, 0])
+
+
+def test_topk_for_user_tie_breaks_lowest_index():
+    U = np.eye(2, dtype=np.float32)
+    V = np.array([[3.0, 0], [1.0, 0], [3.0, 0]], dtype=np.float32)
+    _vals, idx = topk.topk_for_user(U, V, np.int32(0), k=3)
+    np.testing.assert_array_equal(np.asarray(idx), [0, 2, 1])
+
+
+def test_stable_topk_total_tie_is_iota():
+    scores = np.zeros((3, 17), dtype=np.float32)
+    vals, idx = topk.stable_topk(scores, 5)
+    np.testing.assert_array_equal(np.asarray(idx),
+                                  np.tile(np.arange(5), (3, 1)))
+    assert np.asarray(vals).shape == (3, 5)
+
+
+def test_host_topk_boundary_ties_lowest_index():
+    """argpartition's selection at the k-th-value boundary is arbitrary
+    among tied entries; host_topk must still pick (and order) the
+    LOWEST indices — the same rule as stable_topk."""
+    scores = np.array([2.0, 1.0, 2.0, 2.0, 0.5, 1.0], dtype=np.float32)
+    vals, idx = topk.host_topk(scores, 4)
+    np.testing.assert_array_equal(idx, [0, 2, 3, 1])
+    np.testing.assert_allclose(vals, [2, 2, 2, 1])
+    # all-equal scores: exactly the k lowest indices, in order
+    ties = np.full(50, 7.0, dtype=np.float32)
+    _v, i = topk.host_topk(ties, 5)
+    np.testing.assert_array_equal(i, np.arange(5))
+    # ties below the boundary don't disturb the strict head
+    scores2 = np.array([9.0, 3.0, 3.0, 8.0, 3.0], dtype=np.float32)
+    _v, i2 = topk.host_topk(scores2, 3)
+    np.testing.assert_array_equal(i2, [0, 3, 1])
+
+
+def test_host_masked_topk_batch_deterministic_ties():
+    """The batched host kernel (per-row host_topk) breaks ties by
+    lowest index with each query's own k."""
+    factors = np.array([[1.0], [1.0], [2.0], [1.0]], dtype=np.float32)
+    queries = np.array([[1.0], [1.0]], dtype=np.float32)
+    masks = [np.ones(4, bool), np.array([True, True, False, True])]
+    rows = topk.host_masked_topk_batch(factors, queries, masks, [3, 3])
+    np.testing.assert_array_equal(rows[0][1], [2, 0, 1])
+    np.testing.assert_array_equal(rows[1][1], [0, 1, 3])
+
+
+def test_host_topk_matches_device_stable_topk():
+    """Host and device kernels agree on selection AND order for data
+    with engineered duplicates (low-bit float noise excluded by
+    construction: scores are exact)."""
+    rng = np.random.default_rng(7)
+    scores = rng.integers(-5, 5, size=64).astype(np.float32)
+    hv, hi = topk.host_topk(scores, 10)
+    dv, di = topk.stable_topk(scores, 10)
+    np.testing.assert_array_equal(hi, np.asarray(di))
+    np.testing.assert_array_equal(hv, np.asarray(dv))
+
+
 def test_host_topk_nonpositive_k_returns_empty():
     """A negative num from request JSON must not return ~all entries
     (negative argpartition slice keeps n+k elements)."""
