@@ -1252,6 +1252,28 @@ def serve_and_measure(storage, engine, n_queries: int = 200):
         server.shutdown()
 
 
+def measure_lint():
+    """`pio lint` over this checkout (tools/analyze): the bench round
+    carries the static-analysis verdict next to the perf numbers, so
+    benchtrend can gate `lint_findings_total` at 0 absolutely and trend
+    the suppressed (accepted-debt) count, which should only shrink.
+    In-process and stdlib-only — costs ~1 s, never touches the device."""
+    try:
+        from predictionio_tpu.tools.analyze.runner import run_lint
+        r = run_lint()
+        return {
+            "lint_findings_total": len(r.active),
+            "lint_suppressed_total": len(r.suppressed),
+            "lint_stale_baseline_total": len(r.stale),
+            "lint_modules_analyzed": r.modules_analyzed,
+            "lint_exit": r.exit_code,
+            "lint_rules_fired": sorted({f.rule for f in r.active}) or None,
+        }
+    except Exception as e:     # the lint must never sink a bench run…
+        # …except under strict extras, where lint_error fails the round
+        return {"lint_error": f"{type(e).__name__}: {e}"}
+
+
 def model_checksum(storage, instance_id: str) -> float:
     """Sum the persisted factor matrices — a host-side consumption barrier
     AND a sanity signal (NaN/garbage shows up immediately)."""
@@ -1543,6 +1565,11 @@ def main() -> None:
             except Exception as e:
                 robust = {"robust_error": f"{type(e).__name__}: {e}"}
 
+        # static-analysis leg (`pio lint`, tools/analyze): always runs —
+        # ~1 s, stdlib-only — so every bench artifact records the lint
+        # verdict; strict extras turn any finding into a failed round
+        lint_leg = measure_lint()
+
         published = {}
         try:
             with open(os.path.join(HERE, "BASELINE.json")) as f:
@@ -1631,6 +1658,7 @@ def main() -> None:
                 **(eval_grid or {}),
                 **(ecom or {}),
                 **(robust or {}),
+                **(lint_leg or {}),
                 "device": str(jax.devices()[0]).split(":")[0],
             },
         }
@@ -1784,6 +1812,18 @@ def main() -> None:
                         f"{ttr_leg['time_to_ready_s']:g} breaches the "
                         "10 s warm-replica gate with "
                         "BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and lint_leg:
+            if lint_leg.get("lint_error"):
+                failures.append(
+                    f"pio lint crashed ({lint_leg['lint_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            elif lint_leg.get("lint_exit", 0) != 0:
+                failures.append(
+                    f"pio lint: {lint_leg.get('lint_findings_total', '?')} "
+                    "active finding(s) "
+                    f"(rules: {lint_leg.get('lint_rules_fired')}) — fix "
+                    "them or accept them into conf/lint_baseline.json "
+                    "with a reason, with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and trend_failures:
             failures.append(
                 "bench trajectory regression vs best prior round: "

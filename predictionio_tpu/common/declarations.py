@@ -1,0 +1,277 @@
+"""The single declaration registry for operational knobs and metrics.
+
+Every ``PIO_*`` environment variable the framework reads and every
+``pio_*`` metric family it exports must be declared HERE, with a
+one-line meaning, and documented in README.md. The ``declarations``
+lint pass (tools/analyze/passes/declarations.py) cross-checks all
+three directions mechanically:
+
+- an env read / metric registration in code with no declaration here is
+  a typo or an undocumented knob (``env-undeclared`` /
+  ``metric-undeclared``);
+- a declaration here whose name appears nowhere in the code is dead
+  weight that misleads operators (``env-dead`` / ``metric-ghost``);
+- a declaration missing from README.md is a knob operators can't
+  discover (``env-undocumented`` / ``metric-undocumented``).
+
+Names ending in ``*`` declare a PREFIX (config families whose full
+names are user-composed, e.g. ``PIO_STORAGE_SOURCES_<NAME>_TYPE``).
+Prefixes are exempt from the dead-declaration check — their concrete
+spellings never appear verbatim in code.
+
+Keep the one-liners operator-grade: what the knob does and its default,
+not where it is read (the lint knows that better than a comment would).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: every PIO_* environment variable -> one-line operator meaning.
+ENV_VARS: Dict[str, str] = {
+    # ------------------------------------------------------ storage core
+    "PIO_FS_BASEDIR":
+        "base directory for the zero-config stores (sqlite metadata, "
+        "eventlog shards, model files, checkpoints); default ~/.pio_store",
+    "PIO_STORAGE_SOURCES_*":
+        "storage source config family: PIO_STORAGE_SOURCES_<NAME>_TYPE "
+        "(memory|sqlite|eventlog|localfs|s3|remote) plus per-type extras "
+        "(_PATH, _URL, _KEY, _RETRIES, _BACKOFF_MS, ...)",
+    "PIO_STORAGE_REPOSITORIES_*":
+        "repository bindings: PIO_STORAGE_REPOSITORIES_"
+        "{METADATA,EVENTDATA,MODELDATA}_SOURCE -> a declared source name",
+    "PIO_STORAGE_SERVER_KEY":
+        "shared secret the storage server requires from remote clients "
+        "(X-PIO-Storage-Key); unset = unauthenticated",
+    "PIO_SERVER_KEY":
+        "server key for the dashboard / admin daemons",
+    "PIO_SSL_CERTFILE":
+        "TLS certificate for the HTTP daemons; unset = plain HTTP",
+    "PIO_SSL_KEYFILE":
+        "TLS private key paired with PIO_SSL_CERTFILE",
+    "PIO_EVENTLOG_CACHE_MB":
+        "decoded-chunk cache budget for eventlog bulk reads (MB, "
+        "default 256)",
+    "PIO_DISABLE_NATIVE":
+        "any value disables the native counting-sort extension "
+        "(falls back to numpy)",
+    # ------------------------------------------------------ read pipeline
+    "PIO_READ_THREADS":
+        "parallel chunk-decode workers for bulk event reads "
+        "(default min(8, cores); 1 = exact serial behavior)",
+    "PIO_READ_OVERLAP":
+        "overlap chunk decode with vocab encode during training reads "
+        "(default 1; 0 = sequential)",
+    "PIO_READ_STAGE":
+        "async per-chunk device_put staging during overlapped reads "
+        "(default 1; 0 = stage nothing)",
+    # ------------------------------------------------------- ALS kernels
+    "PIO_ALS_KERNEL":
+        "ALS trainer kernel: hybrid (default) | csrb | scan",
+    "PIO_ALS_SOLVER":
+        "per-row solver: gj (default) | pallas (experimental TPU solve)",
+    "PIO_ALS_HOT_K":
+        "hybrid kernel: number of hot items on the dense path "
+        "(default 4096)",
+    "PIO_ALS_DENSE_MIN_COUNT":
+        "hybrid kernel: minimum rating count for the dense-hot path "
+        "(default 64)",
+    "PIO_ALS_XPAD":
+        "pad the expanded factor matrix to the lane width (default 1; "
+        "0 = unpadded, debugging only)",
+    "PIO_ALS_LAYOUT_CACHE":
+        "retain prepared COO layouts keyed by content fingerprint "
+        "(default 1; 0 = rebuild every train)",
+    "PIO_ALS_BIG_LAYOUT_MIN":
+        "nnz threshold above which layout prep reports progress and the "
+        "layout cache is strongly preferred (default 2e6)",
+    "PIO_NNZ_BUCKETING":
+        "bucket padded nnz so close sizes share one compiled program "
+        "(default 1; 0 = exact-size programs)",
+    "PIO_FINITE_CHECK":
+        "post-train non-finite factor check that fails the run instead "
+        "of persisting NaN (default 1)",
+    # ----------------------------------------------------------- serving
+    "PIO_SERVE_BUCKETS":
+        "comma-separated padding bucket sizes for batched serving "
+        "(default 1,4,16,64)",
+    "PIO_SERVE_DEVICE_MS":
+        "estimated device-dispatch threshold (ms) below which the "
+        "inline single-query device path is used (default 3.0)",
+    "PIO_SERVE_SHARD":
+        "row-sharded serving over the device mesh: 1/0 overrides "
+        "`pio deploy --shard-serving auto`",
+    "PIO_SERVE_WARMUP_FLUSHES":
+        "flush count that ends the recompile watchdog's warmup when no "
+        "explicit AOT-complete mark arrives (default 32)",
+    # --------------------------------------------------------------- AOT
+    "PIO_AOT":
+        "ahead-of-time serving compilation: 1/0 overrides "
+        "`pio deploy --aot auto` (0 restores the lazy pre-AOT deploy)",
+    "PIO_AOT_KS":
+        "comma-separated k values to enumerate serving programs for "
+        "(default 10, clamped to the model)",
+    "PIO_AOT_PRUNE":
+        "prune AOT buckets against the observed flush-size histogram "
+        "(default 1; 0 = build every declared bucket)",
+    "PIO_AOT_THREADS":
+        "AOT prebuild thread-pool width (default 4)",
+    "PIO_COMPILE_CACHE_DIR":
+        "persistent XLA compile-cache directory; train exports its new "
+        "entries as a deploy artifact, deploy pre-seeds from it",
+    "PIO_COMPILE_CACHE_MIN_S":
+        "minimum compile seconds before a program is persisted to the "
+        "compile cache (default 0)",
+    # -------------------------------------------------------- resilience
+    "PIO_RPC_RETRIES":
+        "remote-storage retry attempts for idempotent calls (default 3)",
+    "PIO_RPC_BACKOFF_MS":
+        "base backoff between remote-storage retries (full jitter)",
+    "PIO_RPC_BACKOFF_MAX_MS":
+        "backoff ceiling for remote-storage retries",
+    "PIO_RPC_DEADLINE_MS":
+        "total retry deadline per remote-storage call; propagated as "
+        "X-PIO-Deadline-Ms",
+    "PIO_RPC_WRITE_DEDUP":
+        "1 arms exactly-once event-insert retries via one-shot write "
+        "tokens (default 0)",
+    "PIO_BREAKER_ENABLED":
+        "1 arms the per-endpoint circuit breaker on remote storage "
+        "clients (default 0)",
+    "PIO_BREAKER_WINDOW_S":
+        "sliding error-rate window for the circuit breaker "
+        "(default 30)",
+    "PIO_BREAKER_ERROR_RATE":
+        "error-rate threshold that opens the breaker (default 0.5)",
+    "PIO_BREAKER_MIN_CALLS":
+        "minimum calls in the window before the breaker may open "
+        "(default 10)",
+    "PIO_BREAKER_OPEN_S":
+        "seconds an open breaker waits before one half-open probe "
+        "(default 5)",
+    "PIO_FAULT_SPEC":
+        "fault-injection spec (drop/latency/error/truncate clauses with "
+        "scopes and rates) for chaos runs",
+    "PIO_FAULT_SEED":
+        "deterministic seed for PIO_FAULT_SPEC firing decisions",
+    "PIO_AUTO_RESUME":
+        "auto-resume `pio train` from a crashed run's iteration "
+        "checkpoints (default 1)",
+    # ----------------------------------------------------- observability
+    "PIO_TELEMETRY":
+        "1 records optional hot-path metrics (GET /metrics serves the "
+        "registry either way)",
+    "PIO_TRACE":
+        "1 originates a Dapper-style trace per incoming request "
+        "(propagated X-PIO-Trace headers are always honored)",
+    "PIO_TRACE_BUFFER":
+        "trace ring-buffer capacity in spans (default 512)",
+    "PIO_WATERFALL":
+        "1 samples per-request latency waterfalls into "
+        "pio_serve_stage_seconds + /debug/slow.json (default 0)",
+    "PIO_WATERFALL_SAMPLE":
+        "sample every Nth request when waterfalls are on (default 1)",
+    "PIO_SLOW_RING":
+        "capacity of the keep-the-N-slowest /debug/slow.json ring "
+        "(default 32)",
+    "PIO_PROFILE_DIR":
+        "directory where POST /debug/profile captures land (artifact "
+        "paths are confined under it)",
+    "PIO_PROFILE_MAX_MS":
+        "hard ceiling on on-demand profile capture length "
+        "(default 10000)",
+    "PIO_PROFILE_ENABLE":
+        "0 disables the POST /debug/profile surface outright (403); "
+        "GET listing stays",
+    "PIO_SLO_AVAILABILITY":
+        "availability SLO target (default 0.999)",
+    "PIO_SLO_LATENCY_MS":
+        "latency SLO threshold in ms (default 25, snapped to a "
+        "histogram bucket edge)",
+    "PIO_SLO_LATENCY_TARGET":
+        "fraction of serves that must meet PIO_SLO_LATENCY_MS "
+        "(default 0.99)",
+    "PIO_SLO_FAST_WINDOW_S":
+        "fast burn-rate window (default 300)",
+    "PIO_SLO_SLOW_WINDOW_S":
+        "slow burn-rate window (default 3600)",
+}
+
+#: every pio_* metric family / collector-emitted series -> one-liner.
+METRICS: Dict[str, str] = {
+    # ------------------------------------------------------- micro-batcher
+    "pio_batcher_batches_total": "flushed batches",
+    "pio_batcher_queries_total": "queries admitted into batches",
+    "pio_batcher_rejected_total":
+        "queries rejected by admission control (503)",
+    "pio_batcher_queue_wait_seconds_total": "summed per-query queue wait",
+    "pio_batcher_flush_seconds": "flush (device dispatch) latency per batch",
+    "pio_batcher_queue_depth": "current admission queue depth",
+    "pio_batcher_batch_size": "batches by exact flush size",
+    "pio_batcher_bucket": "batches by padding-bucket occupancy",
+    # ------------------------------------------------------------- serving
+    "pio_serve_seconds": "per-request serve latency",
+    "pio_serve_stage_seconds":
+        "per-stage waterfall latency (admission/supplement/dispatch/pad/"
+        "execute/merge/serialize) with trace-id exemplars",
+    "pio_serve_shards": "live shard count of the sharded serving path",
+    "pio_degraded_batches_total":
+        "flushes tainted by a failed side-channel lookup",
+    "pio_degraded_queries_upper_bound":
+        "responses flagged degraded (upper bound; batch-granular)",
+    "pio_time_to_ready_seconds": "deploy start to /readyz ready",
+    # ----------------------------------------------------------------- AOT
+    "pio_aot_programs_total": "AOT program builds by status",
+    "pio_aot_prebuild_seconds": "AOT prebuild wall time",
+    # ------------------------------------------------------------ training
+    "pio_train_phase_seconds": "train phase durations (read/layout/...)",
+    "pio_layout_cache_total": "layout-cache hits/misses/skips",
+    "pio_read_chunk_decode_seconds": "eventlog chunk decode latency",
+    "pio_staging_chunks_total": "async device-staging chunks enqueued",
+    "pio_staging_rows_total": "async device-staging rows enqueued",
+    "pio_staging_finalize_enqueue_seconds":
+        "staging finalize ENQUEUE time (async stream deliberately "
+        "unsynced; the layout phase owns the barrier)",
+    # ----------------------------------------------------------- transport
+    "pio_http_requests_total": "HTTP requests by path/code",
+    "pio_http_request_seconds": "HTTP request handling latency",
+    "pio_events_requests_total": "event-server API requests (collector)",
+    "pio_events_ingested_total": "events ingested (collector)",
+    "pio_rpc_retries_total": "remote-storage retries by endpoint",
+    "pio_rpc_dedup_replays_total":
+        "server-side dedup replays of retried writes",
+    "pio_breaker_transitions_total": "circuit-breaker state transitions",
+    "pio_breaker_open": "1 while a breaker is open (collector)",
+    # -------------------------------------------------------- device watch
+    "pio_xla_compiles_total": "XLA compiles attributed to entry points",
+    "pio_xla_compile_seconds": "XLA compile durations",
+    "pio_xla_post_warmup_recompiles_total":
+        "the alarm: serving-path compiles after warmup",
+    "pio_hbm_bytes_in_use": "device memory_stats bytes_in_use (collector)",
+    "pio_hbm_bytes_limit": "device memory_stats bytes_limit (collector)",
+    "pio_hbm_peak_bytes_in_use":
+        "device memory_stats peak bytes (collector)",
+    "pio_live_arrays": "live jax array count at scrape (collector)",
+    "pio_live_array_bytes": "live jax array bytes at scrape (collector)",
+    "pio_compile_cache_entries":
+        "persistent compile-cache entry count (collector)",
+    "pio_compile_cache_bytes":
+        "persistent compile-cache size in bytes (collector)",
+    # ---------------------------------------------------------------- SLO
+    "pio_slo_target": "configured SLO objective (collector)",
+    "pio_slo_error_budget_remaining":
+        "error budget left, 1 = untouched (collector)",
+    "pio_slo_burn_rate":
+        "error rate / allowed rate over fast+slow windows (collector)",
+}
+
+
+def env_prefixes() -> Dict[str, str]:
+    """The declared prefix families (names ending in ``*``), with the
+    ``*`` stripped."""
+    return {k[:-1]: v for k, v in ENV_VARS.items() if k.endswith("*")}
+
+
+def env_exact() -> Dict[str, str]:
+    """The declared exact env names (no prefix families)."""
+    return {k: v for k, v in ENV_VARS.items() if not k.endswith("*")}

@@ -65,7 +65,7 @@ def _cosine_gram(rows: np.ndarray, threshold: float,
     for s in range(0, n_users, chunk):
         block = jnp.asarray(rows[s:s + chunk], dtype=jnp.float32)
         gram = mm(gram, block)
-    g = np.asarray(gram)
+    g = jax.device_get(gram)
     norms = np.sqrt(np.maximum(np.diag(g), 1e-12))
     sim = g / norms[None, :] / norms[:, None]
     np.fill_diagonal(sim, 0.0)

@@ -49,7 +49,7 @@ def train(features, labels, lambda_: float = 1.0,
     features = jnp.asarray(features, dtype=jnp.float32)
     labels = jnp.asarray(labels, dtype=jnp.int32)
     if n_classes is None:
-        n_classes = int(jnp.max(labels)) + 1
+        n_classes = int(jax.device_get(jnp.max(labels))) + 1
     pi, theta = _train(features, labels, jnp.float32(lambda_), n_classes)
     return NaiveBayesModel(pi=pi, theta=theta, n_classes=n_classes)
 

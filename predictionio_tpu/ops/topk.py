@@ -70,9 +70,11 @@ def topk_for_user(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused single-query serve: row gather + matvec + top_k in ONE
     dispatch, so a remote/tunneled device costs one round-trip per query
-    instead of four (gather, matmul, and two fetches). Tie-deterministic
-    (stable_topk) so the inline path agrees bit-for-bit with the batched
-    and sharded kernels on tied scores."""
+    instead of four (gather, matmul, and two fetches). `user_ix` must be
+    in-bounds — callers resolve it against the model's user vocabulary
+    first (an OOB index would gather NaN, KNOWN_ISSUES.md #5).
+    Tie-deterministic (stable_topk) so the inline path agrees bit-for-bit
+    with the batched and sharded kernels on tied scores."""
     q = jnp.take(user_factors, user_ix, axis=0)
     return stable_topk(item_factors @ q, k)
 

@@ -502,7 +502,9 @@ def _train_sharded_hybrid(
             X = _expand_X(V, r, jnp.float32)          # (n_rows_pad_i, w)
             # f32 into the dense kernels: they split hi/lo bf16 internally
             # (a pre-cast here would silently zero the lo correction term)
-            X_hot = jnp.take(X, hot_addr, axis=0)
+            # hot_addr = si.pos[hot_gids]: padded item addresses in
+            # [0, n_rows_pad) by construction — X has n_rows_pad rows
+            X_hot = jnp.take(X, hot_addr, axis=0)  # pio-lint: allow=gather-clip
             AB = _dense_hot_user(D_blk, X_hot, K, r)
             AB = AB + _gram_tail(X, u_lay, su.rows_dev, b, u_chunk,
                                  implicit, alpha, r)

@@ -1,0 +1,130 @@
+"""AOT registration coverage (re-homed from tests/test_aot.py).
+
+Every ``@jax.jit`` entry point on the serving path must be registered
+with the AOT enumerator (``serving/aot.register_jit``) — an
+unregistered kernel compiles lazily on the first request and silently
+reintroduces the warmup cliff the AOT subsystem exists to kill.
+
+The original lint kept a hand-maintained serving-module list that PR 8
+had to remember to extend. Here the serving scope is STRUCTURAL: the
+whole ``serving/`` package, plus any module that itself calls
+``register_jit`` (a module contributing programs to the enumerator is
+on the serving path by definition — this is how ``ops/topk.py`` and
+``parallel/serve_dist.py`` enter without being listed), plus any module
+a ``register_jit`` call resolves into cross-module (how ``ops/als.py``'s
+training kernels are covered). A future serving-path module either
+registers its kernels (and is then held to account for ALL of its jit
+defs) or lives under ``serving/`` where coverage is unconditional.
+
+The registered-name set is built statically from every
+``register_jit(name, fn)`` call in the repo: ``fn``'s final attribute
+is the function name, matched against the module's jit-decorated defs.
+The runtime half (object-identity matching after real imports) stays in
+tests/test_aot.py; this pass is what makes coverage automatic for
+modules nobody remembered to list.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set
+
+from predictionio_tpu.tools.analyze.findings import Finding
+from predictionio_tpu.tools.analyze.passes import Pass
+from predictionio_tpu.tools.analyze.walker import (
+    Module, dotted_name, jit_decorated_defs, module_alias_map,
+)
+
+_RULE = "aot-unregistered-jit"
+
+_SERVING_PKG = "predictionio_tpu/serving/"
+
+
+def registered_fn_names(modules: Sequence[Module]) -> Set[str]:
+    """Final attribute/name of the second arg of every register_jit
+    call (``als._train_hybrid_jit`` -> ``_train_hybrid_jit``)."""
+    out: Set[str] = set()
+    for mod in modules:
+        if mod.tree is None or "register_jit" not in mod.source:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            dn = dotted_name(node.func)
+            if not dn or not (dn == "register_jit"
+                              or dn.endswith(".register_jit")):
+                continue
+            target = node.args[1]
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                out.add(target.attr)
+    return out
+
+
+def serving_scope(modules: Sequence[Module]) -> List[Module]:
+    """Modules held to the registration rule (see module docstring)."""
+    # modules a cross-module register_jit call resolves INTO
+    referenced: Set[str] = set()
+    for mod in modules:
+        if mod.tree is None or "register_jit" not in mod.source:
+            continue
+        aliases = module_alias_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            dn = dotted_name(node.func)
+            if not dn or not (dn == "register_jit"
+                              or dn.endswith(".register_jit")):
+                continue
+            target = node.args[1]
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)):
+                modname = aliases.get(target.value.id, "")
+                if modname:
+                    referenced.add(modname.replace(".", "/") + ".py")
+    out = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        in_scope = (mod.rel.startswith(_SERVING_PKG)
+                    or "register_jit" in mod.source
+                    or mod.rel in referenced)
+        if in_scope:
+            out.append(mod)
+    return out
+
+
+def run(modules: Sequence[Module]) -> List[Finding]:
+    registered = registered_fn_names(modules)
+    out: List[Finding] = []
+    for mod in serving_scope(modules):
+        assert mod.tree is not None
+        if mod.module_allows(_RULE):
+            continue
+        for fn in jit_decorated_defs(mod.tree):
+            if fn.name in registered:
+                continue
+            if mod.line_allows(fn.lineno, _RULE):
+                continue
+            out.append(Finding(
+                rule=_RULE, path=mod.rel, line=fn.lineno,
+                message=f"@jax.jit def '{fn.name}' on the serving path "
+                        "is not registered with the AOT enumerator — it "
+                        "compiles lazily on the first request "
+                        "(the warmup cliff, KNOWN_ISSUES #9)",
+                hint="register it via serving/aot.register_jit (and "
+                     "declare its shapes so deploy prebuilds it before "
+                     "/readyz); for a genuinely non-serving kernel in a "
+                     "serving module, suppress with '# pio-lint: "
+                     "allow=aot-unregistered-jit' and say why",
+                detail=fn.name))
+    return out
+
+
+PASS = Pass(
+    name="aot-registration",
+    rules=(_RULE,),
+    doc="every @jax.jit entry point on the serving path is registered "
+        "with the AOT enumerator (no lazy first-request compiles)",
+    run=run)

@@ -1,0 +1,128 @@
+"""Debug-surface unity (re-homed from tests/test_timing_lint.py).
+
+Every ``/debug/*`` endpoint must ride the SHARED
+``telemetry.handle_route`` so the three daemons can never drift apart —
+the event server once lacked a surface the query server had. Two rules:
+
+- ``debug-path-unshared``: any ``/debug/...`` string constant anywhere
+  in the repo must be one of ``telemetry.DEBUG_PATHS`` (read statically
+  from common/telemetry.py's AST — no import, so ``pio lint`` stays
+  jax-free). Query-bearing forms (``/debug/slow.json?limit=3``) of a
+  shared path stay legal.
+- ``daemon-no-handle-route``: each daemon route handler must call
+  ``telemetry.handle_route``. The three daemon modules are a structural
+  fact of the architecture (query/event/storage), not an opt-in
+  coverage list — a FOURTH daemon would be caught by rule one the
+  moment it referenced a debug path privately.
+
+The runtime half (every DEBUG_PATHS surface answers 200 on live APIs)
+stays in tests/test_timing_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from predictionio_tpu.tools.analyze.findings import Finding
+from predictionio_tpu.tools.analyze.passes import Pass
+from predictionio_tpu.tools.analyze.walker import Module, dotted_name
+
+_UNSHARED = "debug-path-unshared"
+_NO_ROUTE = "daemon-no-handle-route"
+
+_TELEMETRY_REL = "predictionio_tpu/common/telemetry.py"
+
+#: the three daemons' route handlers (architectural constant)
+DAEMON_MODULES = (
+    "predictionio_tpu/workflow/create_server.py",   # query (QueryAPI)
+    "predictionio_tpu/data/api/service.py",         # event (EventAPI)
+    "predictionio_tpu/data/storage/remote.py",      # storage (RPC API)
+)
+
+
+def shared_debug_paths(modules: Sequence[Module]) -> Optional[Set[str]]:
+    """``DEBUG_PATHS`` parsed from common/telemetry.py, or None when the
+    assignment cannot be found (then the rule abstains rather than
+    flagging everything)."""
+    for mod in modules:
+        if mod.rel != _TELEMETRY_REL or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "DEBUG_PATHS":
+                    value = node.value
+                    if isinstance(value, (ast.Tuple, ast.List)):
+                        return {e.value for e in value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)}
+    return None
+
+
+def run(modules: Sequence[Module]) -> List[Finding]:
+    shared = shared_debug_paths(modules)
+    out: List[Finding] = []
+    if shared is not None:
+        for mod in modules:
+            if mod.tree is None or "/debug/" not in mod.source:
+                continue
+            if mod.module_allows(_UNSHARED):
+                continue
+            for node in ast.walk(mod.tree):
+                # the bare "/debug/" prefix (this pass's own probe
+                # string) is not an endpoint — only named paths count
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value.startswith("/debug/")
+                        and node.value != "/debug/"):
+                    continue
+                const = node.value
+                if any(const == p or const.startswith(p + "?")
+                       for p in shared):
+                    continue
+                if mod.line_allows(node.lineno, _UNSHARED):
+                    continue
+                out.append(Finding(
+                    rule=_UNSHARED, path=mod.rel, line=node.lineno,
+                    message=f"debug endpoint {const!r} is not served by "
+                            "telemetry.DEBUG_PATHS — wired into one "
+                            "daemon privately, it drifts off the other "
+                            "two",
+                    hint="register the path in common/telemetry.py "
+                         "handle_route (DEBUG_PATHS) so all three "
+                         "daemons serve it",
+                    detail=const))
+    by_rel = {m.rel: m for m in modules}
+    for rel in DAEMON_MODULES:
+        mod = by_rel.get(rel)
+        if mod is None or mod.tree is None or mod.module_allows(_NO_ROUTE):
+            continue
+        calls = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, ast.Call)
+                 and dotted_name(n.func) == "telemetry.handle_route"]
+        if not calls:
+            out.append(Finding(
+                rule=_NO_ROUTE, path=mod.rel, line=1,
+                message="daemon route handler never calls "
+                        "telemetry.handle_route — its /metrics, "
+                        "/traces.json and /debug/* surface has drifted "
+                        "off",
+                hint="route unmatched paths through "
+                     "telemetry.handle_route before answering 404",
+                detail=rel))
+    return out
+
+
+PASS = Pass(
+    name="debug-surface",
+    rules=(_UNSHARED, _NO_ROUTE),
+    doc="every /debug/* path rides the shared telemetry.handle_route; "
+        "all three daemons serve the same surface",
+    run=run)

@@ -1,0 +1,372 @@
+"""Host-transfer hygiene on the device hot paths (KNOWN_ISSUES #3/#5).
+
+Two rule families, both dataflow-lite so they stay precise enough to run
+repo-wide without an opt-in module list:
+
+- ``hostsync-implicit``: an implicit device→host sync — ``float()`` /
+  ``int()`` / ``bool()`` / ``np.asarray()`` / ``.item()`` / ``print()``
+  applied to a value that provably came from a jax computation (a
+  ``jnp.``/``lax.``-rooted expression, or a local assigned from one in
+  the enclosing function stack). Each of these blocks the calling
+  thread on device completion mid-path, invisibly to every timer and
+  trace span; the sanctioned transfer is an explicit
+  ``jax.device_get`` at the END of the timed region (which this rule
+  deliberately exempts). Inside a jit-traced body (``@jax.jit`` defs
+  and everything reachable through ``serving/aot.register_jit``) the
+  same calls are flagged on ANY non-constant argument — there they
+  don't sync, they bake the traced value's placeholder in at trace
+  time or throw ``TracerError`` on the first real batch.
+- ``gather-clip``: ``jnp.take`` whose index operand is not provably
+  clipped. Padded COO layouts use ``n_self`` as the padding index and
+  jax fills out-of-bounds float gathers with NaN, which survives
+  masking (``NaN * 0 = NaN``, KNOWN_ISSUES #5 — ``ops/als.py:rmse``
+  was bitten by exactly this). An index is accepted when it is built
+  from a clipping/bounded op (``clip``/``minimum``/``where``/
+  ``arange``/``argsort``/...) in the enclosing function stack, when
+  the call states an explicit ``mode=``, or when it is a parameter of
+  a function whose docstring states the in-bounds contract. Anything
+  else needs the clip — or a ``# pio-lint: allow=gather-clip`` pragma
+  carrying the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from predictionio_tpu.tools.analyze.findings import Finding
+from predictionio_tpu.tools.analyze.passes import Pass
+from predictionio_tpu.tools.analyze.walker import (
+    Module, dotted_name, jit_decorated_defs, jitted_bodies,
+    module_alias_map, registered_jit_defs,
+)
+
+_IMPLICIT = "hostsync-implicit"
+_GATHER = "gather-clip"
+
+#: index expressions built through these ops are bounded by construction
+_SAFE_INDEX_CALLS = frozenset({
+    "clip", "minimum", "maximum", "where", "mod", "remainder", "arange",
+    "argsort", "argpartition", "searchsorted", "iota", "floor_divide",
+    "repeat", "nonzero", "top_k",
+})
+
+#: a docstring mentioning any of these states the caller-side bounds
+#: contract for a parameter-indexed gather (KNOWN_ISSUES #5 wording)
+_POLICY_WORDS = ("clip", "in-bounds", "in bounds", "out-of-bounds",
+                 "out of bounds", "oob", "known_issues")
+
+
+def _jax_roots(mod: Module) -> Set[str]:
+    """Local names that address jax namespaces (jnp/lax/jax aliases)."""
+    assert mod.tree is not None
+    roots: Set[str] = set()
+    for local, target in module_alias_map(mod.tree).items():
+        if target in ("jax.numpy", "jax.lax", "jax", "jax.ops"):
+            roots.add(local)
+    return roots
+
+
+#: jax API calls that return HOST objects (device handles, counts) —
+#: not arrays, so converting/printing them is not a sync
+_NON_ARRAY_API = frozenset({
+    "device_get", "devices", "local_devices", "device_count",
+    "local_device_count", "process_count", "process_index",
+    "default_backend", "live_arrays",
+})
+
+
+def _device_rooted(node: ast.AST, roots: Set[str]) -> bool:
+    """Is this expression a jax computation? (``device_get`` chains are
+    the sanctioned transfer; device/process introspection returns host
+    objects — both excepted.)"""
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        if dn:
+            head = dn.split(".", 1)[0]
+            if head in roots:
+                return not any(part in _NON_ARRAY_API
+                               for part in dn.split("."))
+        return _device_rooted(node.func, roots)
+    if isinstance(node, (ast.Attribute, ast.Subscript)):
+        return _device_rooted(node.value, roots)
+    if isinstance(node, ast.BinOp):
+        return (_device_rooted(node.left, roots)
+                or _device_rooted(node.right, roots))
+    if isinstance(node, ast.UnaryOp):
+        return _device_rooted(node.operand, roots)
+    return False
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_assigned_names(elt))
+        return out
+    return []
+
+
+def _device_locals(fn: ast.AST, roots: Set[str]) -> Set[str]:
+    """Names assigned from jax-rooted expressions inside ``fn``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _device_rooted(node.value,
+                                                           roots):
+            for t in node.targets:
+                out.update(_assigned_names(t))
+        elif (isinstance(node, (ast.AugAssign, ast.AnnAssign))
+                and node.value is not None
+                and _device_rooted(node.value, roots)):
+            out.update(_assigned_names(node.target))
+    return out
+
+
+def _static_params(fn: ast.AST) -> Set[str]:
+    """Names declared static in the jit decoration (Python values at
+    trace time — int()/bool() of them is host arithmetic, not a sync)."""
+    out: Set[str] = set()
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec
+        if (isinstance(dec, ast.Call) and dec.args
+                and isinstance(dec.func, ast.Name)
+                and dec.func.id == "partial"):
+            target = dec.args[0]
+        call = target if isinstance(target, ast.Call) else dec
+        if not isinstance(call, ast.Call):
+            continue
+        for kw in call.keywords:
+            if kw.arg == "static_argnames" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                out.update(e.value for e in kw.value.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+            elif (kw.arg == "static_argnames"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                out.add(kw.value.value)
+    return out
+
+
+def _shape_ish(node: ast.AST) -> bool:
+    """Shape/size expressions are concrete Python ints even under
+    tracing — converting them is not a sync."""
+    if isinstance(node, ast.Subscript):
+        return _shape_ish(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size", "dtype")
+    if isinstance(node, ast.Call):
+        return (isinstance(node.func, ast.Name)
+                and node.func.id in ("len", "min", "max"))
+    if isinstance(node, ast.BinOp):
+        return _shape_ish(node.left) and _shape_ish(node.right)
+    return False
+
+
+def _sync_kind(call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+    """(description, suspect-argument) when ``call`` is one of the
+    implicit-sync shapes, else None."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool"):
+        if len(call.args) == 1:
+            return f"{fn.id}()", call.args[0]
+    dn = dotted_name(fn)
+    if dn in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+        if call.args:
+            return dn, call.args[0]
+    if isinstance(fn, ast.Name) and fn.id == "print" and call.args:
+        return "print()", call.args[0]
+    if isinstance(fn, ast.Attribute) and fn.attr == "item" and not call.args:
+        return ".item()", fn.value
+    return None
+
+
+def _jit_fn_set(mod: Module,
+                registered: Sequence[Tuple[Module, ast.FunctionDef]]
+                ) -> Set[ast.AST]:
+    assert mod.tree is not None
+    fns: Set[ast.AST] = set(jit_decorated_defs(mod.tree))
+    fns.update(fn for _n, fn in jitted_bodies(mod.tree))
+    fns.update(fn for m, fn in registered if m is mod)
+    return fns
+
+
+def _implicit_findings(mod: Module, jit_fns: Set[ast.AST]
+                       ) -> List[Finding]:
+    assert mod.tree is not None
+    roots = _jax_roots(mod)
+    out: List[Finding] = []
+    seen: Set[int] = set()
+
+    def scan(scope: ast.AST, dev_names: Set[str], in_jit: bool,
+             static: Set[str] = frozenset()) -> None:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            kind = _sync_kind(node)
+            if kind is None:
+                continue
+            desc, arg = kind
+            suspect = (_device_rooted(arg, roots)
+                       or (isinstance(arg, ast.Name)
+                           and arg.id in dev_names))
+            if in_jit and not suspect:
+                # inside a traced body the provenance doesn't matter:
+                # the argument IS a tracer unless it's a literal, a
+                # static-argname parameter, or a shape expression
+                suspect = not (
+                    isinstance(arg, ast.Constant)
+                    or (isinstance(arg, ast.Name) and arg.id in static)
+                    or _shape_ish(arg))
+            if not suspect:
+                continue
+            seen.add(id(node))
+            where = ("inside a jit-traced body" if in_jit
+                     else "on a jax value")
+            out.append(Finding(
+                rule=_IMPLICIT, path=mod.rel, line=node.lineno,
+                message=f"{desc} {where} forces an implicit device->host "
+                        "sync (KNOWN_ISSUES #3)",
+                hint="end the region in an explicit jax.device_get (the "
+                     "sanctioned transfer) or keep the value on device; "
+                     "inside jit, hoist the host interaction out of the "
+                     "traced body"))
+
+    if not roots:
+        return out
+    # jit bodies first (stricter rule marks their call sites as seen)
+    for fn in jit_fns:
+        if not mod.line_allows(getattr(fn, "lineno", 1), _IMPLICIT):
+            scan(fn, _device_locals(fn, roots), in_jit=True,
+                 static=_static_params(fn))
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan(node, _device_locals(node, roots), in_jit=False)
+    # module level: only TOP-LEVEL statements with TOP-LEVEL provenance —
+    # walking the whole tree with module-wide dev-locals would let one
+    # function's jax local poison a same-named parameter elsewhere
+    top_dev: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and _device_rooted(stmt.value,
+                                                           roots):
+            for t in stmt.targets:
+                top_dev.update(_assigned_names(t))
+    for stmt in mod.tree.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            scan(stmt, top_dev, in_jit=False)
+    return [f for f in out if not mod.line_allows(f.line, _IMPLICIT)]
+
+
+# ---------------------------------------------------------------------------
+# gather-clip
+# ---------------------------------------------------------------------------
+
+def _contains_safe_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func)
+            if dn and dn.split(".")[-1] in _SAFE_INDEX_CALLS:
+                return True
+    return False
+
+
+def _index_is_safe(idx: ast.AST, stack: Sequence[ast.AST]) -> bool:
+    """Clipped-by-construction? ``stack`` is the enclosing def chain
+    (innermost last), used to resolve local assignments and parameter
+    contracts."""
+    if isinstance(idx, ast.Constant):
+        return True
+    if _contains_safe_call(idx):
+        return True
+    if isinstance(idx, ast.Name):
+        name = idx.id
+        for scope in stack:
+            for node in ast.walk(scope):
+                if (isinstance(node, ast.Assign)
+                        and name in _assigned_names_any(node.targets)
+                        and _contains_safe_call(node.value)):
+                    return True
+        # a parameter whose function documents the bounds contract
+        for scope in reversed(stack):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in (scope.args.args
+                                      + scope.args.kwonlyargs
+                                      + scope.args.posonlyargs)}
+            if name in params:
+                doc = (ast.get_docstring(scope) or "").lower()
+                return any(w in doc for w in _POLICY_WORDS)
+    return False
+
+
+def _assigned_names_any(targets: Sequence[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    for t in targets:
+        out.update(_assigned_names(t))
+    return out
+
+
+def _gather_findings(mod: Module) -> List[Finding]:
+    assert mod.tree is not None
+    roots = _jax_roots(mod)
+    if not roots:
+        return []
+    out: List[Finding] = []
+
+    def visit(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node]
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if (dn and dn.split(".")[-1] == "take"
+                    and dn.split(".", 1)[0] in roots
+                    and len(node.args) >= 2):
+                has_mode = any(kw.arg == "mode" for kw in node.keywords)
+                idx = node.args[1]
+                if (not has_mode and not _index_is_safe(idx, stack)
+                        and not mod.line_allows(node.lineno, _GATHER)):
+                    out.append(Finding(
+                        rule=_GATHER, path=mod.rel, line=node.lineno,
+                        message="jnp.take with an index that is not "
+                                "provably clipped — an out-of-bounds "
+                                "gather fills NaN, which survives "
+                                "masking (KNOWN_ISSUES #5)",
+                        hint="clip the index (jnp.clip/minimum) before "
+                             "the gather, pass an explicit mode=, state "
+                             "the caller contract in the enclosing "
+                             "docstring, or suppress with '# pio-lint: "
+                             "allow=gather-clip' and say why the index "
+                             "is in bounds"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(mod.tree, [mod.tree])
+    return out
+
+
+def run(modules: Sequence[Module]) -> List[Finding]:
+    registered = registered_jit_defs(modules)
+    out: List[Finding] = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        if mod.module_allows(_IMPLICIT) and mod.module_allows(_GATHER):
+            continue
+        if not mod.module_allows(_IMPLICIT):
+            out.extend(_implicit_findings(
+                mod, _jit_fn_set(mod, registered)))
+        if not mod.module_allows(_GATHER) and ".take(" in mod.source:
+            out.extend(_gather_findings(mod))
+    return out
+
+
+PASS = Pass(
+    name="host-sync",
+    rules=(_IMPLICIT, _GATHER),
+    doc="no implicit device->host syncs on hot paths; padded gathers "
+        "clip their indices (KNOWN_ISSUES #3/#5)",
+    run=run)

@@ -1,0 +1,137 @@
+"""Purity of jit-traced bodies.
+
+``jax.jit`` runs the Python body ONCE per shape signature; whatever it
+does besides building the traced computation is frozen into the program
+(clocks, RNG draws) or replayed only on retrace (I/O, global mutation).
+All four shapes have bitten real JAX codebases as "works in eager, wrong
+under jit" bugs, so this pass bans them inside every traced body — the
+``@jax.jit`` defs, module-level ``jax.jit(f)`` wraps, and everything
+reachable through ``serving/aot.register_jit`` (resolved cross-module).
+
+Rules:
+
+- ``jit-wall-clock``: any ``time.*`` call — the value is read at TRACE
+  time; the compiled program carries that one constant forever. A timer
+  around device work belongs OUTSIDE the jit boundary (and must end in
+  a host transfer, KNOWN_ISSUES #3/#7).
+- ``jit-nondeterminism``: ``random.*`` / ``np.random.*`` draws — one
+  sample at trace time, silently reused by every execution; jax PRNG
+  keys (``jax.random`` with an explicit key argument) are the traced
+  alternative and are NOT flagged.
+- ``jit-io``: ``open()`` / ``print()`` / ``logging`` / ``os.environ``
+  reads — executed once per retrace instead of once per call; an env
+  read inside a kernel also bakes deploy-time config into the program.
+- ``jit-global-mutation``: ``global`` / ``nonlocal`` declarations —
+  the mutation happens at trace time only, the compiled program never
+  repeats it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set, Tuple
+
+from predictionio_tpu.tools.analyze.findings import Finding
+from predictionio_tpu.tools.analyze.passes import Pass
+from predictionio_tpu.tools.analyze.walker import (
+    Module, dotted_name, jit_decorated_defs, jitted_bodies,
+    registered_jit_defs,
+)
+
+_WALL = "jit-wall-clock"
+_RAND = "jit-nondeterminism"
+_IO = "jit-io"
+_GLOBAL = "jit-global-mutation"
+
+_IO_NAMES = frozenset({"open", "print", "input"})
+
+
+def _rule_for_call(call: ast.Call) -> Tuple[str, str]:
+    """(rule, description) for an impure call, or ("", "")."""
+    dn = dotted_name(call.func)
+    if dn:
+        head = dn.split(".", 1)[0]
+        if head == "time":
+            return _WALL, f"{dn}()"
+        if dn.startswith("np.random.") or dn.startswith("numpy.random."):
+            return _RAND, f"{dn}()"
+        if head == "random":
+            return _RAND, f"{dn}()"
+        if dn.startswith("os.environ") or dn in ("os.getenv",):
+            return _IO, f"{dn}()"
+        if head in ("logging", "logger", "log"):
+            return _IO, f"{dn}()"
+        if dn in _IO_NAMES:
+            return _IO, f"{dn}()"
+    return "", ""
+
+
+def _body_findings(mod: Module, name: str,
+                   fn: ast.FunctionDef) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            if not mod.line_allows(node.lineno, _GLOBAL):
+                kw = ("global" if isinstance(node, ast.Global)
+                      else "nonlocal")
+                out.append(Finding(
+                    rule=_GLOBAL, path=mod.rel, line=node.lineno,
+                    message=f"{kw} mutation inside jit-traced "
+                            f"'{name}' happens once at trace time, "
+                            "never on execution",
+                    hint="return the value and let the caller store "
+                         "it, or move the state update outside the "
+                         "traced body", detail=f"{name}:{kw}"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        rule, desc = _rule_for_call(node)
+        if not rule or mod.line_allows(node.lineno, rule):
+            continue
+        consequence = {
+            _WALL: "is read once at trace time and baked into the "
+                   "compiled program as a constant",
+            _RAND: "draws one sample at trace time that every "
+                   "execution silently reuses (use jax.random with "
+                   "an explicit key instead)",
+            _IO: "runs once per retrace, not once per call",
+        }[rule]
+        out.append(Finding(
+            rule=rule, path=mod.rel, line=node.lineno,
+            message=f"{desc} inside jit-traced '{name}' {consequence}",
+            hint="hoist the call out of the traced body; pass the "
+                 "value in as an argument if the kernel needs it"))
+    return out
+
+
+def run(modules: Sequence[Module]) -> List[Finding]:
+    registered = registered_jit_defs(modules)
+    out: List[Finding] = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        bodies: List[Tuple[str, ast.FunctionDef]] = []
+        seen: Set[int] = set()
+        for fn in jit_decorated_defs(mod.tree):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                bodies.append((fn.name, fn))
+        for name, fn in jitted_bodies(mod.tree):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                bodies.append((name, fn))
+        for m, fn in registered:
+            if m is mod and id(fn) not in seen:
+                seen.add(id(fn))
+                bodies.append((fn.name, fn))
+        for name, fn in bodies:
+            out.extend(_body_findings(mod, name, fn))
+    return out
+
+
+PASS = Pass(
+    name="jit-purity",
+    rules=(_WALL, _RAND, _IO, _GLOBAL),
+    doc="no clocks, host RNG, I/O, or global mutation inside jit-traced "
+        "bodies (trace-time constants / once-per-retrace effects)",
+    run=run)

@@ -85,6 +85,12 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # BENCH_STRICT_EXTRAS=1, trended here
     ("serve_sharded_p99_ms", "down", False),
     ("serve_sharded_overhead_pct", "down", False),
+    # static-analysis era (tools/analyze): `pio lint` runs inside the
+    # bench's strict leg; findings are gated at 0 absolutely below,
+    # suppressed counts are trended so baseline debt is visible per
+    # round (it should only ever shrink)
+    ("lint_findings_total", "down", False),
+    ("lint_suppressed_total", "down", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round
@@ -94,6 +100,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
 #: from the model's artifact must be servable in seconds.
 ABSOLUTE_GATES: Dict[str, float] = {
     "time_to_ready_s": 10.0,
+}
+
+#: absolute ceilings enforced UNCONDITIONALLY on the newest round (no
+#: warm-cache precondition): `pio lint` findings are 0 on every round
+#: or the round fails — new static-analysis debt can't ride a bench
+#: artifact in. (The suppression baseline is how accepted debt is
+#: recorded; it keeps findings at 0 without hiding NEW findings.)
+ABSOLUTE_GATES_ALWAYS: Dict[str, float] = {
+    "lint_findings_total": 1.0,
 }
 
 #: regression tolerance vs the best prior run; generous on purpose —
@@ -209,6 +224,12 @@ def gate(rounds: Sequence[Dict[str, Any]],
             failures.append(
                 f"{key}: {v:g} exceeds the absolute ceiling {limit:g} "
                 "(warm-replica availability contract)")
+    for key, limit in ABSOLUTE_GATES_ALWAYS.items():
+        v = metric_value(last, key)
+        if v is not None and v >= limit:
+            failures.append(
+                f"{key}: {v:g} must be 0 — fix the findings or accept "
+                "them into conf/lint_baseline.json with a reason")
     if len(rounds) < 2:
         return failures
     for key, direction, gated in METRICS:

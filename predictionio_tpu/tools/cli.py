@@ -259,6 +259,28 @@ def cmd_doctor(args) -> int:
     return run_doctor(url, timeout=args.timeout)
 
 
+def cmd_lint(args) -> int:
+    """Repo-wide static analysis (tools/analyze): the KNOWN_ISSUES
+    invariants as lint passes — timing honesty, implicit host syncs,
+    gather clipping, jit purity, lock ordering, declaration
+    cross-checks, AOT registration, debug-surface unity. Exit 0 clean /
+    1 findings / 2 internal error. Stdlib-only: runs without touching
+    jax or a device."""
+    from predictionio_tpu.tools.analyze.runner import main as lint_main
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.list_passes:
+        argv.append("--list")
+    if args.root:
+        argv += ["--root", args.root]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    return lint_main(argv)
+
+
 def cmd_undeploy(args) -> int:
     from predictionio_tpu.workflow.create_server import undeploy
     if undeploy(args.ip, args.port):
@@ -681,6 +703,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--timeout", type=float, default=5.0,
                     help="per-request timeout in seconds")
 
+    sp = sub.add_parser(
+        "lint",
+        help="repo-wide static analysis of the KNOWN_ISSUES invariants "
+             "(tools/analyze; exit 0 clean / 1 findings / 2 internal "
+             "error)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable result on stdout")
+    sp.add_argument("--update-baseline", action="store_true",
+                    help="accept the current findings into the "
+                         "suppression baseline (conf/lint_baseline.json)")
+    sp.add_argument("--list", dest="list_passes", action="store_true",
+                    help="list passes and rules, run nothing")
+    sp.add_argument("--root", default="",
+                    help="repo root (default: autodetected)")
+    sp.add_argument("--baseline", default="",
+                    help="baseline path (default conf/lint_baseline.json)")
+
     sp = sub.add_parser("run", help="run an arbitrary entry point")
     sp.add_argument("main_class")
     sp.add_argument("args", nargs="*")
@@ -786,6 +825,7 @@ _DISPATCH = {
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
     "doctor": cmd_doctor,
+    "lint": cmd_lint,
     "profile": cmd_profile,
     "run": cmd_run,
     "eventserver": cmd_eventserver,

@@ -122,10 +122,15 @@ def _jit_decorated_defs(path):
 
 
 def test_every_serving_path_jit_is_registered():
-    """The anti-regression lint: a new jitted kernel on the serving
-    path (ops/topk.py or serving/*) that is not registered with the
-    AOT enumerator would silently reintroduce the warmup cliff — here
-    it is a test failure instead."""
+    """RUNTIME half of the AOT-registration lint: after real imports,
+    every jitted def in these modules is the SAME OBJECT a register_jit
+    call recorded (catches registration of a stale alias/wrapper). The
+    static half — which modules are in scope at all — is now the
+    structural `aot-registration` pass of `pio lint`
+    (tools/analyze/passes/aot_registration.py): repo-wide, no opt-in
+    list; tests/test_lint.py asserts this list is a subset of what the
+    pass discovers, so a module added here without the pass knowing it
+    is impossible."""
     import importlib
 
     serving_modules = [

@@ -205,6 +205,29 @@ def test_absolute_gate_breach_exits_nonzero_via_cli(tmp_path, capsys):
     assert benchtrend.main(paths) == 0
 
 
+def test_lint_findings_gate_is_unconditional(tmp_path):
+    """`lint_findings_total` gates at 0 on the NEWEST round regardless
+    of cache state or history depth — static-analysis debt can't ride a
+    cold-cache round in, and suppressed (baselined) findings don't
+    trip it."""
+    # a single COLD round with findings still fails
+    p = _write_round(tmp_path, 1, 1.0,
+                     {"lint_findings_total": 3, **_COLD})
+    rounds, _ = benchtrend.load_rounds([p])
+    failures = benchtrend.gate(rounds)
+    assert len(failures) == 1 and "lint_findings_total" in failures[0]
+    # clean lint with accepted baseline debt passes
+    ok = _write_round(tmp_path, 1, 1.0,
+                      {"lint_findings_total": 0,
+                       "lint_suppressed_total": 5, **_COLD})
+    rounds, _ = benchtrend.load_rounds([ok])
+    assert benchtrend.gate(rounds) == []
+    # rounds predating the lint leg (no key at all) are not judged
+    legacy = _write_round(tmp_path, 1, 1.0, {})
+    rounds, _ = benchtrend.load_rounds([legacy])
+    assert benchtrend.gate(rounds) == []
+
+
 @pytest.mark.parametrize("gate_flag", [False, True])
 def test_real_repo_history_renders_and_passes(gate_flag, capsys):
     """The actual 5-round BENCH_r*.json series in the repo: the table

@@ -1,0 +1,95 @@
+"""`parallel/mesh.py::shard_map_compat` across both API spellings.
+
+The shim picked up 29 tests in PR 8 by accepting whichever shard_map
+the running jax exposes — `jax.shard_map` (newer, `check_vma=`) or
+`jax.experimental.shard_map.shard_map` (0.4.x, `check_rep=`). Only the
+spelling the installed jax happens to ship was ever exercised; here the
+OTHER branch is forced via import-shim monkeypatching so a jax upgrade
+(or downgrade) can't silently break the path nobody ran.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from predictionio_tpu.parallel import mesh as mesh_mod
+
+
+def _psum_through(compat_result):
+    """Run the wrapped kernel on a 1-device mesh and return the sum."""
+    return np.asarray(compat_result(jnp.arange(8, dtype=jnp.float32)))
+
+
+def _kernel(x):
+    return jax.lax.psum(jnp.sum(x), "block")
+
+
+def test_shard_map_compat_native_spelling(monkeypatch):
+    """`jax.shard_map` present -> used, with the check_vma spelling."""
+    calls = {}
+
+    def fake_shard_map(f, mesh, in_specs, out_specs, **kwargs):
+        calls.update(kwargs, mesh=mesh, in_specs=in_specs)
+        # delegate to the real implementation so the wrapped kernel is
+        # genuinely executable — the fake only asserts the call shape
+        from jax.experimental.shard_map import shard_map
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map, raising=False)
+    m = mesh_mod.get_mesh(1)
+    wrapped = mesh_mod.shard_map_compat(_kernel, m, (P("block"),), P())
+    assert calls["check_vma"] is False          # the new-API spelling
+    assert "check_rep" not in calls
+    assert calls["mesh"] is m
+    assert calls["in_specs"] == (P("block"),)   # sequence normalized
+    assert _psum_through(wrapped) == pytest.approx(28.0)
+
+
+def test_shard_map_compat_experimental_fallback(monkeypatch):
+    """No `jax.shard_map` -> the jax.experimental spelling, check_rep."""
+    monkeypatch.delattr(jax, "shard_map", raising=False)
+    assert not hasattr(jax, "shard_map")
+
+    import jax.experimental.shard_map as exp_mod
+    real = exp_mod.shard_map
+    calls = {}
+
+    def spying_shard_map(*args, **kwargs):
+        # jax re-enters shard_map positionally during tracing — record
+        # only the shim's call (check_rep passed by keyword), forward all
+        if "check_rep" in kwargs:
+            calls.update(kwargs)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(exp_mod, "shard_map", spying_shard_map)
+    m = mesh_mod.get_mesh(1)
+    wrapped = mesh_mod.shard_map_compat(_kernel, m, [P("block")], P())
+    assert calls["check_rep"] is False          # the 0.4.x spelling
+    assert "check_vma" not in calls
+    assert _psum_through(wrapped) == pytest.approx(28.0)
+
+
+def test_shard_map_compat_branches_agree(monkeypatch):
+    """Both spellings produce the same numbers for the same kernel."""
+    m = mesh_mod.get_mesh(1)
+    via_fallback = _psum_through(
+        mesh_mod.shard_map_compat(_kernel, m, (P("block"),), P()))
+
+    def native(f, mesh, in_specs, out_specs, check_vma):
+        from jax.experimental.shard_map import shard_map
+        assert check_vma is False
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+    monkeypatch.setattr(jax, "shard_map", native, raising=False)
+    via_native = _psum_through(
+        mesh_mod.shard_map_compat(_kernel, m, (P("block"),), P()))
+    np.testing.assert_array_equal(via_fallback, via_native)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -47,8 +47,18 @@ r = rng.uniform(0.5, 5.0, nnz).astype(np.float32)
 data = als.prepare_ratings(u, i, r, n_u, n_i)
 
 mesh = get_mesh()                          # all 8 GLOBAL devices
-U, V = als_dist.train_explicit_sharded(mesh, data, rank=5, iterations=4,
-                                       lambda_=0.05, seed=9)
+try:
+    U, V = als_dist.train_explicit_sharded(mesh, data, rank=5, iterations=4,
+                                           lambda_=0.05, seed=9)
+except Exception as e:  # capability gate, not error handling: some
+    # backends (jaxlib 0.4.x CPU) cannot RUN computations that span
+    # processes at all — report the capability gap to the parent so it
+    # can skip with the reason instead of failing the suite
+    if "Multiprocess computations aren't implemented" in str(e):
+        with open(out_path, "w") as f:
+            json.dump({"unsupported": str(e).splitlines()[-1]}, f)
+        sys.exit(0)
+    raise
 
 # hybrid kernel across the same two-process mesh: the dense-hot psum and
 # per-device D shards must also work over DCN (K lowered so the split
@@ -99,6 +109,12 @@ def test_two_process_mesh_matches_single_process(tmp_path, monkeypatch):
         assert p.returncode == 0, f"worker {pid} failed:\n{logs[pid][-3000:]}"
 
     got = [json.loads(o.read_text()) for o in outs]
+    unsupported = [g["unsupported"] for g in got if "unsupported" in g]
+    if unsupported:
+        import pytest
+        pytest.skip("backend does not support multiprocess computations "
+                    f"(two-process DCN leg needs a real multi-host "
+                    f"platform here): {unsupported[0]}")
     assert got[0]["process_count"] == 2
     # both processes computed (and can read) the SAME replicated factors
     np.testing.assert_array_equal(np.asarray(got[0]["U"]),

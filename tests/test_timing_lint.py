@@ -1,191 +1,69 @@
-"""Mechanical enforcement of the timing rules (tier-1).
+"""Timing honesty + debug-surface unity: the runtime halves, plus the
+tier-1 delegation onto the `pio lint` passes.
 
-1. No ``time.time()`` anywhere in ``predictionio_tpu/``: every timed
-   region must use ``time.perf_counter()`` (monotonic, not subject to
-   NTP steps — a wall-clock delta can go NEGATIVE mid-measurement).
-   Wall-clock timestamps, where genuinely needed (event times, span
-   display timestamps), come from timezone-aware ``datetime`` instead,
-   so the ban is total and the lint stays trivially greppable.
+The static AST lints that lived here pre-PR 9 (no `time.time()`, no
+`block_until_ready` in timed modules, every `/debug/*` path on the
+shared route) are now passes on the shared walker
+(tools/analyze/passes/timing.py, debug_surface.py) — repo-wide with
+opt-OUT pragmas instead of this file's old hand-maintained opt-in
+lists. The tests below run those passes over the real tree so the rules
+still gate tier-1 from their historical home; seeded-defect proofs and
+the old-list-containment assertions live in tests/test_lint.py.
 
-2. No ``block_until_ready`` as a timing barrier in instrumented modules:
-   on the tunneled axon platform it can return before results land on
-   host (KNOWN_ISSUES #3), silently under-reporting any clock stopped
-   behind it. Timed regions must end in a real host transfer
-   (``jax.device_get``) instead.
-
-AST-based (not just grep) so aliased imports are caught too.
+What stays here natively is what static analysis cannot see: the
+runtime half of the debug-surface rule (every DEBUG_PATHS surface
+actually answers 200 on live daemon APIs).
 """
-
-import ast
-import os
 
 import pytest
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "predictionio_tpu")
+from predictionio_tpu.tools.analyze.passes import debug_surface, timing
+from predictionio_tpu.tools.analyze.walker import discover
 
 
-def _py_files():
-    for root, _dirs, files in os.walk(PKG):
-        if "__pycache__" in root:
-            continue
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
-
-
-def _time_time_calls(tree, module_aliases, func_aliases):
-    """Call sites that resolve to time.time in this module."""
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if (isinstance(fn, ast.Attribute) and fn.attr == "time"
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id in module_aliases):
-            hits.append(node.lineno)
-        elif isinstance(fn, ast.Name) and fn.id in func_aliases:
-            hits.append(node.lineno)
-    return hits
-
-
-def _aliases(tree):
-    """(names bound to the time MODULE, names bound to time.time)."""
-    module_aliases, func_aliases = set(), set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    module_aliases.add(a.asname or "time")
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "time":
-                    func_aliases.add(a.asname or "time")
-    return module_aliases, func_aliases
+def _active(findings):
+    """Pragma handling happens inside the passes; anything returned is
+    an active violation."""
+    return [f"{f.path}:{f.line} [{f.rule}]" for f in findings]
 
 
 def test_no_wall_clock_time_in_package():
-    offenders = []
-    for path in _py_files():
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        if "time" not in src:        # cheap pre-filter
-            continue
-        tree = ast.parse(src, filename=path)
-        module_aliases, func_aliases = _aliases(tree)
-        if not module_aliases and not func_aliases:
-            continue
-        for line in _time_time_calls(tree, module_aliases, func_aliases):
-            rel = os.path.relpath(path, os.path.dirname(PKG))
-            offenders.append(f"{rel}:{line}")
-    assert not offenders, (
-        "time.time() found in timing-sensitive package code — use "
-        "time.perf_counter() (monotonic) for durations or timezone-aware "
-        "datetime for wall-clock timestamps:\n  " + "\n  ".join(offenders))
+    """No time.time() anywhere in the repo-of-record: durations come
+    from time.perf_counter() (monotonic — a wall-clock delta can go
+    NEGATIVE mid-measurement under NTP steps), wall-clock timestamps
+    from timezone-aware datetime. Now covers bench.py and diagnostics/
+    too, not just the package."""
+    findings = [f for f in timing.run(discover())
+                if f.rule == "timing-wall-clock"]
+    assert not findings, "\n  ".join(_active(findings))
 
 
-#: modules whose timed regions feed telemetry/phase tables; a
-#: block_until_ready here is the exact KNOWN_ISSUES #3 bug shape. (ops/
-#: kernels may legitimately use it for non-timing dispatch control.)
-_TIMED_MODULES = (
-    "common/telemetry.py", "common/tracing.py", "common/devicewatch.py",
-    "common/waterfall.py", "common/profiling.py", "common/slo.py",
-    "serving/batcher.py", "serving/aot.py", "parallel/serve_dist.py",
-    "workflow/context.py", "workflow/core_workflow.py",
-    "workflow/create_server.py", "data/store.py", "ops/staging.py",
-    "models/recommendation/als_algorithm.py",
-    "tools/benchtrend.py", "tools/doctor.py", "tools/profile.py",
-)
+def test_no_block_until_ready_anywhere():
+    """block_until_ready can return before results land on host
+    (KNOWN_ISSUES #3): timed regions end in a real host transfer
+    (jax.device_get). Was opt-IN over 18 listed modules; now every
+    module is covered and legitimate non-timing barriers opt OUT in
+    their own source with a justified pragma."""
+    findings = [f for f in timing.run(discover())
+                if f.rule == "timing-block-until-ready"]
+    assert not findings, "\n  ".join(_active(findings))
 
 
-def test_no_block_until_ready_in_timed_modules():
-    offenders = []
-    for rel in _TIMED_MODULES:
-        path = os.path.join(PKG, rel)
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        for node in ast.walk(tree):   # AST: docstrings/comments don't trip
-            if ((isinstance(node, ast.Attribute)
-                 and node.attr == "block_until_ready")
-                    or (isinstance(node, ast.Name)
-                        and node.id == "block_until_ready")):
-                offenders.append(f"predictionio_tpu/{rel}:{node.lineno}")
-    assert not offenders, (
-        "block_until_ready in a timed module — it can return early on "
-        "tunneled platforms (KNOWN_ISSUES #3); end the region in a real "
-        "host transfer (jax.device_get) instead:\n  "
-        + "\n  ".join(offenders))
+def test_debug_surface_unified():
+    """Every /debug/* path rides telemetry.DEBUG_PATHS and all three
+    daemons consult telemetry.handle_route (KNOWN shape: the event
+    server once lacked a surface the query server had)."""
+    findings = debug_surface.run(discover())
+    assert not findings, "\n  ".join(_active(findings))
 
 
-# ---------------------------------------------------------------------------
-# debug-surface lint: every /debug/* endpoint must ride the SHARED
-# telemetry.handle_route so the three daemons can never drift apart
-# (the event server once lacked a surface the query server had; this
-# makes that class of bug a failing tier-1 test)
-# ---------------------------------------------------------------------------
-
-#: the daemon route handlers that must consult telemetry.handle_route
-_DAEMON_MODULES = (
-    "workflow/create_server.py",   # query server (QueryAPI.handle)
-    "data/api/service.py",         # event server (EventAPI._route)
-    "data/storage/remote.py",      # storage server (StorageRPCAPI.handle)
-)
-
-
-def _debug_string_constants(tree):
-    return {node.value for node in ast.walk(tree)
-            if isinstance(node, ast.Constant)
-            and isinstance(node.value, str)
-            and node.value.startswith("/debug/")}
-
-
-def test_debug_endpoints_only_defined_in_shared_handle_route():
-    """Every /debug/* path compared anywhere in the package must be one
-    telemetry.DEBUG_PATHS serves — a debug endpoint wired into a single
-    daemon's private route table would drift off the other two."""
+def test_debug_paths_parse_from_telemetry_source():
+    """The pass reads DEBUG_PATHS statically (no jax import); it must
+    agree with the imported module — if the assignment ever becomes
+    dynamic the pass would abstain and this test catches it."""
     from predictionio_tpu.common import telemetry
-    offenders = []
-    for path in _py_files():
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        if "/debug/" not in src:
-            continue
-        tree = ast.parse(src, filename=path)
-        for const in _debug_string_constants(tree):
-            # startswith-match so query-bearing scrape paths
-            # ("/debug/slow.json?limit=3") stay legal
-            if not any(const == p or const.startswith(p + "?")
-                       for p in telemetry.DEBUG_PATHS):
-                rel = os.path.relpath(path, os.path.dirname(PKG))
-                offenders.append(f"{rel}: {const!r}")
-    assert not offenders, (
-        "debug endpoint(s) referenced outside telemetry.DEBUG_PATHS — "
-        "register them in common/telemetry.py handle_route so all three "
-        "daemons serve them:\n  " + "\n  ".join(offenders))
-
-
-def test_every_daemon_consults_shared_handle_route():
-    """Each daemon's route handler must call telemetry.handle_route —
-    that one call is what puts every DEBUG_PATHS surface (and /metrics,
-    /traces.json) on its wire."""
-    missing = []
-    for rel in _DAEMON_MODULES:
-        path = os.path.join(PKG, rel)
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        calls = [n for n in ast.walk(tree)
-                 if isinstance(n, ast.Call)
-                 and isinstance(n.func, ast.Attribute)
-                 and n.func.attr == "handle_route"
-                 and isinstance(n.func.value, ast.Name)
-                 and n.func.value.id == "telemetry"]
-        if not calls:
-            missing.append(rel)
-    assert not missing, (
-        "daemon route handler(s) never call telemetry.handle_route — "
-        "their /debug/* surface has drifted off:\n  "
-        + "\n  ".join(missing))
+    parsed = debug_surface.shared_debug_paths(discover())
+    assert parsed == set(telemetry.DEBUG_PATHS)
 
 
 def test_debug_paths_answer_on_event_and_storage_daemons(memory_storage):
@@ -203,20 +81,6 @@ def test_debug_paths_answer_on_event_and_storage_daemons(memory_storage):
             response = api.handle("GET", path)
             assert response[0] == 200, (type(api).__name__, path,
                                         response)
-
-
-def test_lint_actually_detects_violations():
-    """The lint is live: a synthetic offender trips it."""
-    tree = ast.parse("import time as t\nx = t.time()\n")
-    m, f = _aliases(tree)
-    assert _time_time_calls(tree, m, f) == [2]
-    tree = ast.parse("from time import time\nx = time()\n")
-    m, f = _aliases(tree)
-    assert _time_time_calls(tree, m, f) == [2]
-    # perf_counter does NOT trip it
-    tree = ast.parse("import time\nx = time.perf_counter()\n")
-    m, f = _aliases(tree)
-    assert _time_time_calls(tree, m, f) == []
 
 
 if __name__ == "__main__":
