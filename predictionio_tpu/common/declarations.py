@@ -52,6 +52,34 @@ ENV_VARS: Dict[str, str] = {
     "PIO_EVENTLOG_CACHE_MB":
         "decoded-chunk cache budget for eventlog bulk reads (MB, "
         "default 256)",
+    "PIO_WAL_GROUP_MS":
+        "WAL group-commit coalescing window in ms — concurrent event "
+        "inserts landing within it share one write+flush, acks release "
+        "after the group lands (default 2; 0 = legacy per-append writes)",
+    "PIO_WAL_FSYNC":
+        "WAL durability: group (default, one fsync per group commit) | "
+        "always (fsync every append, no coalescing wait) | off (no "
+        "fsync — power-loss window, KNOWN_ISSUES #11)",
+    # ----------------------------------------------------- HTTP transport
+    "PIO_TRANSPORT":
+        "daemon HTTP transport: threaded (default, stdlib thread-per-"
+        "connection) | async (single event loop, keep-alive + HTTP/1.1 "
+        "pipelining, handlers on a bounded executor); wire bytes "
+        "identical in both modes",
+    "PIO_TRANSPORT_WORKERS":
+        "async transport: handler executor width (default "
+        "min(32, 4x cores))",
+    "PIO_TRANSPORT_PIPELINE":
+        "async transport: max pipelined requests in flight per "
+        "connection, responses stay in order (default 16)",
+    "PIO_BATCH_EVENTS_MAX":
+        "per-request item cap for POST /batch/events.json (default 50, "
+        "EventServer.scala:70 parity)",
+    "PIO_BATCH_BULK_INSERT":
+        "store a batch request's accepted items in one insert_batch "
+        "call (default 1 — one lock round trip + one group-commit wait "
+        "per request); 0 = per-item inserts with per-item storage-error "
+        "isolation (the pre-async-stack behavior)",
     "PIO_DISABLE_NATIVE":
         "any value disables the native counting-sort extension "
         "(falls back to numpy)",
@@ -135,6 +163,9 @@ ENV_VARS: Dict[str, str] = {
     "PIO_RPC_WRITE_DEDUP":
         "1 arms exactly-once event-insert retries via one-shot write "
         "tokens (default 0)",
+    "PIO_RPC_POOL":
+        "idle keep-alive connections the remote-storage driver retains "
+        "in its shared pool (default 8; failed sockets never re-pool)",
     "PIO_BREAKER_ENABLED":
         "1 arms the per-endpoint circuit breaker on remote storage "
         "clients (default 0)",
@@ -238,6 +269,8 @@ METRICS: Dict[str, str] = {
     "pio_events_requests_total": "event-server API requests (collector)",
     "pio_events_ingested_total": "events ingested (collector)",
     "pio_rpc_retries_total": "remote-storage retries by endpoint",
+    "pio_wal_group_commit_seconds": "WAL group-commit write+flush latency",
+    "pio_wal_group_commit_events": "events coalesced per WAL group commit",
     "pio_rpc_dedup_replays_total":
         "server-side dedup replays of retried writes",
     "pio_breaker_transitions_total": "circuit-breaker state transitions",

@@ -14,6 +14,7 @@ Route surface parity:
   POST   /events.json               -> 201 {"eventId": id}
   GET    /events.json               -> filtered list (default limit 20)
   POST   /batch/events.json         -> per-item statuses, cap 50
+                                       (PIO_BATCH_EVENTS_MAX overrides)
   GET    /stats.json                -> stats | 404 unless --stats
   POST   /webhooks/<name>.json      -> connector ingest
   GET    /webhooks/<name>.json      -> connector presence check
@@ -28,6 +29,7 @@ import binascii
 import dataclasses
 import json
 import logging
+import os
 import urllib.parse
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -35,7 +37,7 @@ from predictionio_tpu.data.api.plugins import (
     EventInfo, EventServerPluginContext,
 )
 from predictionio_tpu.data.api.stats import StatsBook
-from predictionio_tpu.data.event import Event, parse_event_time
+from predictionio_tpu.data.event import Event, parse_event_time, utcnow_ms
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.data.webhooks import (
     ConnectorException, default_form_connectors, default_json_connectors,
@@ -44,9 +46,33 @@ from predictionio_tpu.data.webhooks import (
 
 logger = logging.getLogger("predictionio_tpu.api")
 
-MAX_EVENTS_PER_BATCH_REQUEST = 50  # EventServer.scala:70
+MAX_EVENTS_PER_BATCH_REQUEST = 50  # EventServer.scala:70 (default cap)
 
 Response = Tuple[int, Any]
+
+
+def batch_events_max() -> int:
+    """Per-request item cap for POST /batch/events.json:
+    ``PIO_BATCH_EVENTS_MAX`` overrides the reference's hardcoded 50
+    (EventServer.scala:70); unset/invalid keeps the default. Read per
+    request so operators can retune a live server via restart-free
+    tooling that rewrites the environment of a new deploy."""
+    raw = os.environ.get("PIO_BATCH_EVENTS_MAX", "")
+    try:
+        v = int(raw) if raw else 0
+    except ValueError:
+        v = 0
+    return v if v > 0 else MAX_EVENTS_PER_BATCH_REQUEST
+
+
+def batch_bulk_insert() -> bool:
+    """Store a batch request's accepted items in one ``insert_batch``
+    call (default) or one at a time (``PIO_BATCH_BULK_INSERT=0``). Bulk
+    is the ingest hot path — one storage-lock round trip and one WAL
+    group-commit wait per request; per-item keeps the pre-bulk behavior
+    where a storage failure mid-batch isolates to that item (and is the
+    configuration the bench's threaded baseline leg reproduces)."""
+    return os.environ.get("PIO_BATCH_BULK_INSERT", "1") != "0"
 
 
 @dataclasses.dataclass
@@ -226,6 +252,8 @@ class EventAPI:
 
     # ------------------------------------------------------------ handlers
     def _bookkeep(self, auth: AuthData, status: int, event: Event) -> None:
+        if not self.config.stats and not self.plugin_context.input_sniffers:
+            return   # per-event call on the batch hot path: nothing to do
         if self.config.stats:
             self.stats.bookkeeping(auth.app_id, status, event)
         for sniffer in self.plugin_context.input_sniffers.values():
@@ -302,36 +330,72 @@ class EventAPI:
 
     def _post_batch(self, auth: AuthData, body: bytes) -> Response:
         """POST /batch/events.json (EventServer.scala:376-462): per-item
-        statuses in original order; whole request is 200 unless oversized."""
+        statuses in original order; whole request is 200 unless oversized.
+
+        Every item that survives validation/authorization/blockers is
+        stored in ONE ``insert_batch`` call: a cap-50 request pays one
+        storage-lock round trip and one WAL group-commit wait instead of
+        50 (and against a `remote` event store, one RPC instead of 50) —
+        this is the ingest front door's hot path. The trade: a storage
+        failure now fails the whole accepted sub-batch with per-item
+        500s rather than item-by-item, which for the supported backends
+        is the realistic failure shape anyway (the WAL/RPC is down, not
+        one row)."""
         try:
             items = json.loads(body.decode("utf-8"))
             if not isinstance(items, list):
                 raise ValueError("batch body must be a JSON array")
         except (ValueError, UnicodeDecodeError) as e:
             return 400, {"message": str(e)}
-        if len(items) > MAX_EVENTS_PER_BATCH_REQUEST:
+        cap = batch_events_max()
+        if len(items) > cap:
             return 400, {"message":
                          "Batch request must have less than or equal to "
-                         f"{MAX_EVENTS_PER_BATCH_REQUEST} events"}
-        results: List[Dict[str, Any]] = []
-        for item in items:
+                         f"{cap} events"}
+        bulk = batch_bulk_insert()
+        now = utcnow_ms()   # one shared arrival timestamp per request
+        allowed = auth.events
+        blockers = self.plugin_context.input_blockers
+        results: List[Optional[Dict[str, Any]]] = [None] * len(items)
+        accepted: List[Tuple[int, Event]] = []
+        for j, item in enumerate(items):
             try:
-                event = Event.from_dict(item)
+                event = Event.from_dict(item, now=now)
             except ValueError as e:
-                results.append({"status": 400, "message": str(e)})
+                results[j] = {"status": 400, "message": str(e)}
                 continue
-            if auth.events and event.event not in auth.events:
-                results.append({
+            if allowed and event.event not in allowed:
+                results[j] = {
                     "status": 403,
-                    "message": f"{event.event} events are not allowed"})
+                    "message": f"{event.event} events are not allowed"}
                 continue
             try:
-                event_id = self._insert_one(auth, event)
+                if blockers:
+                    for blocker in blockers.values():
+                        blocker.process(
+                            EventInfo(auth.app_id, auth.channel_id, event),
+                            self.plugin_context)
+                if not bulk:
+                    event_id = self.events.insert(
+                        event, auth.app_id, auth.channel_id)
+                    self._bookkeep(auth, 201, event)
+                    results[j] = {"status": 201, "eventId": event_id}
+                    continue
             except Exception as e:
-                results.append({"status": 500, "message": str(e)})
+                results[j] = {"status": 500, "message": str(e)}
                 continue
-            self._bookkeep(auth, 201, event)
-            results.append({"status": 201, "eventId": event_id})
+            accepted.append((j, event))
+        if accepted:
+            try:
+                ids = self.events.insert_batch(
+                    [e for _, e in accepted], auth.app_id, auth.channel_id)
+            except Exception as e:
+                for j, _e in accepted:
+                    results[j] = {"status": 500, "message": str(e)}
+            else:
+                for (j, event), event_id in zip(accepted, ids):
+                    self._bookkeep(auth, 201, event)
+                    results[j] = {"status": 201, "eventId": event_id}
         return 200, results
 
     # ------------------------------------------------------------ webhooks

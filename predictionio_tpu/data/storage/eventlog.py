@@ -37,9 +37,14 @@ MD5-prefixed rowkeys let many region servers ingest one app's events):
 
 - WITHIN one event-server process, appends are RLock-serialized and any
   number of HTTP connections share the writer — `bench.py` measures
-  POST /batch/events.json at 1/8/32 parallel connections. Ingestion is
-  parse-bound (GIL), so connections add concurrency headroom, not linear
-  throughput; the lock itself is not the bottleneck.
+  POST /batch/events.json at 1/8/32/128 parallel connections. Ingestion
+  is parse-bound (GIL), so connections add concurrency headroom, not
+  linear throughput; the lock itself is not the bottleneck. Concurrent
+  appends GROUP-COMMIT: inserts enlisting within one bounded window
+  (``PIO_WAL_GROUP_MS``, default 2 ms; 0 = legacy per-append writes)
+  share a single WAL write+flush (+fsync per ``PIO_WAL_FSYNC``), and an
+  insert only returns — i.e. the HTTP 201 is only released — after its
+  group's commit lands, so "acknowledged" still implies "durable".
 - ACROSS processes, writers must route through the single owner: either
   the event server itself, or `pio storageserver` (the remote backend,
   data/storage/remote.py) which gives any number of driver processes a
@@ -68,6 +73,7 @@ import logging
 import os
 import shutil
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -82,6 +88,79 @@ logger = logging.getLogger(__name__)
 
 _FLUSH_AT = 1 << 16  # buffered events per (app, channel) before compaction
 _MAX_EXACT_INT = 1 << 53  # beyond float64 exactness -> JSON side-channel
+
+
+def _wal_group_ms() -> float:
+    """Group-commit coalescing window (ms). Appends from concurrent
+    inserts that land within one window share a single WAL write+flush
+    (+fsync per :func:`_wal_fsync_mode`); the 201 ack is released only
+    after that group commit lands. 0 disables grouping and restores the
+    exact per-append legacy path."""
+    raw = os.environ.get("PIO_WAL_GROUP_MS", "")
+    try:
+        v = float(raw) if raw else 2.0
+    except ValueError:
+        v = 2.0
+    return max(0.0, v)
+
+
+def _wal_fsync_mode() -> str:
+    """WAL durability knob (``PIO_WAL_FSYNC``):
+
+    - ``group`` (default): one ``os.fsync`` per group commit — every
+      acknowledged event survives power loss, amortized over the group;
+    - ``always``: fsync every append immediately, no coalescing wait —
+      the strongest (and slowest) setting;
+    - ``off``: never fsync; appends only reach the OS page cache.
+      Survives a process crash, NOT a host power loss — see
+      KNOWN_ISSUES #11 for the data-loss window.
+    """
+    mode = os.environ.get("PIO_WAL_FSYNC", "group").lower()
+    return mode if mode in ("group", "always", "off") else "group"
+
+
+#: unconditional (legacy-tier) group-commit counters, mutated only under
+#: the events lock; the bench ingest leg reads deltas of these, and the
+#: registry histograms below mirror them when PIO_TELEMETRY=1
+WAL_GROUP_STATS: Dict[str, float] = {
+    "commits": 0, "events": 0, "flush_s": 0.0, "max_events": 0}
+
+
+def _wal_line(e: Event) -> str:
+    """One WAL record: the event's wire dict as one compact JSON line
+    (compact separators — the bytes are replay input, not a human
+    surface, and the encode is on the ingest hot path)."""
+    return json.dumps(e.to_dict(with_event_id=False),
+                      separators=(",", ":")) + "\n"
+
+
+class _WalGroup:
+    """One open commit group: the WAL lines of every insert that enlisted
+    since the previous commit, plus the gate their acks wait on. The
+    first enlisted thread to claim leadership performs the single
+    write+flush(+fsync) for everyone; a chunk compaction that supersedes
+    the group (the rows are durable in the chunk) finishes it without
+    writing a byte."""
+
+    __slots__ = ("seq", "lines", "members", "event", "error", "done",
+                 "_lead")
+
+    def __init__(self, seq: int):
+        self.seq = seq
+        self.lines: List[str] = []
+        self.members = 0
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.done = False
+        self._lead = threading.Lock()
+
+    def claim_leader(self) -> bool:
+        return self._lead.acquire(blocking=False)
+
+    def finish(self, error: Optional[BaseException]) -> None:
+        self.error = error
+        self.done = True
+        self.event.set()
 
 
 def _read_thread_count(explicit: Optional[int] = None) -> int:
@@ -183,6 +262,7 @@ class _Shard:
         self.buffer: List[Event] = []
         self.wal_offset = 0
         self.dirty = False  # True only after a LOCAL write (writer role)
+        self.wal_group: Optional[_WalGroup] = None  # open commit group
         self.idx_cache: Dict[int, object] = {}
         self.refresh_wal()
 
@@ -323,14 +403,25 @@ class _Shard:
             with open(path, "r+b") as f:
                 f.truncate(consumed)
 
-    def append_wal(self, events: Sequence[Event]) -> None:
+    def append_wal(self, events: Sequence[Event],
+                   fsync: bool = False) -> None:
+        self.append_wal_lines([_wal_line(e) for e in events], fsync=fsync)
+
+    def append_wal_lines(self, lines: Sequence[str],
+                         fsync: bool = False) -> None:
+        """One write+flush for a batch of pre-encoded WAL records — the
+        group-commit write primitive (and the legacy per-append path with
+        a single caller's lines). ``fsync`` forces the bytes to stable
+        storage before returning; without it they reach the OS page
+        cache only (process-crash-safe, not power-loss-safe)."""
         path = self.wal_path_for(self.next_seq)
         if os.path.exists(path):
             self._repair_torn_tail(path, self.wal_offset, "WAL")
         with open(path, "a", encoding="utf-8") as f:
-            for e in events:
-                f.write(json.dumps(e.to_dict(with_event_id=False)) + "\n")
+            f.write("".join(lines))
             f.flush()
+            if fsync:
+                os.fsync(f.fileno())
             self.wal_offset = f.tell()
 
     def drop_stale_wals(self) -> None:
@@ -556,6 +647,11 @@ class EventlogEvents(Events):
         self.client = client
         self._shards: Dict[Tuple[int, Optional[int]], _Shard] = {}
         self._lock = threading.RLock()
+        #: concurrent insert_batch count — the group-commit leader only
+        #: pays the coalescing window when someone is actually there to
+        #: coalesce with, so sequential callers keep legacy latency
+        self._ingest_inflight = 0
+        self._inflight_lock = threading.Lock()
         atexit.register(self.close)
 
     # -- shard management ----------------------------------------------------
@@ -599,34 +695,124 @@ class EventlogEvents(Events):
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> List[str]:
         sh = self._shard(app_id, channel_id)
+        with self._inflight_lock:
+            self._ingest_inflight += 1
+        try:
+            return self._insert_batch_inner(sh, events)
+        finally:
+            with self._inflight_lock:
+                self._ingest_inflight -= 1
+
+    def _insert_batch_inner(self, sh: _Shard,
+                            events: Sequence[Event]) -> List[str]:
+        group_ms = _wal_group_ms()
+        fsync_mode = _wal_fsync_mode()
+        # WAL lines encode before the lock: json round-trips are the
+        # CPU-heavy half of an append and need no shard state
+        wal_lines = [_wal_line(e) for e in events]
+        group: Optional[_WalGroup] = None
         with self._lock:
             # make every string durable in the dictionary up front (one
             # append), so buffered events are encodable by any reader
             strings: List[str] = []
+            add = strings.append
             for e in events:
-                strings.append(e.event)
-                strings.append(e.entity_type)
-                strings.append(e.entity_id)
+                add(e.event)
+                add(e.entity_type)
+                add(e.entity_id)
                 if e.target_entity_type is not None:
-                    strings.append(e.target_entity_type)
+                    add(e.target_entity_type)
                 if e.target_entity_id is not None:
-                    strings.append(e.target_entity_id)
+                    add(e.target_entity_id)
             sh.add_strings(strings)
             sh.dirty = True
             ids: List[str] = []
-            pending: List[Event] = []
-            for e in events:
-                ids.append(f"{sh.token}-{sh.next_seq}-{len(sh.buffer)}")
+            pending_lines: List[str] = []
+            id_prefix = f"{sh.token}-{sh.next_seq}-"
+            for j, e in enumerate(events):
+                ids.append(id_prefix + str(len(sh.buffer)))
                 sh.buffer.append(e)
-                pending.append(e)
+                pending_lines.append(wal_lines[j])
                 if len(sh.buffer) >= _FLUSH_AT:
                     # the chunk itself makes these durable; pending WAL
-                    # lines for them are no longer needed
+                    # lines for them are no longer needed (this also
+                    # finishes any open group as superseded)
                     self._flush_shard(sh)
-                    pending = []
-            if pending:
-                sh.append_wal(pending)
-            return ids
+                    pending_lines = []
+                    id_prefix = f"{sh.token}-{sh.next_seq}-"
+            if not pending_lines:
+                return ids
+            if group_ms <= 0.0:
+                # legacy per-append path, byte-for-byte (plus the
+                # explicit fsync=always opt-in)
+                sh.append_wal_lines(pending_lines,
+                                    fsync=fsync_mode == "always")
+                return ids
+            group = sh.wal_group
+            if group is None or group.done:
+                group = sh.wal_group = _WalGroup(sh.next_seq)
+            group.lines.extend(pending_lines)
+            group.members += 1
+        # ---- outside the lock: the group-commit protocol ----
+        # The first enlisted thread to claim leadership commits the
+        # whole group; everyone else just waits for the gate. The 201
+        # ack (our return) is released only after the commit lands —
+        # that is the durability contract group commit must not weaken.
+        if group.claim_leader():
+            if fsync_mode != "always":
+                with self._inflight_lock:
+                    crowded = self._ingest_inflight > 1
+                if crowded:
+                    # bounded coalescing window: let concurrent inserts
+                    # enlist so one write+flush covers all of them
+                    time.sleep(group_ms / 1e3)
+            with self._lock:
+                self._commit_wal_group(sh, group, fsync_mode)
+        if not group.event.wait(timeout=60.0):
+            raise RuntimeError(
+                "WAL group commit timed out; the acknowledgement "
+                "cannot be released without durability")
+        if group.error is not None:
+            raise group.error
+        return ids
+
+    def _commit_wal_group(self, sh: _Shard, group: _WalGroup,
+                          fsync_mode: str) -> None:
+        """Write one group's lines in a single append (caller holds the
+        lock). A group whose seq was superseded by a published chunk is
+        already durable — finish it without touching the WAL."""
+        if group.done:
+            return
+        if sh.wal_group is group:
+            sh.wal_group = None
+        try:
+            if group.seq >= sh.next_seq:
+                t0 = time.perf_counter()
+                sh.append_wal_lines(group.lines,
+                                    fsync=fsync_mode != "off")
+                dt = time.perf_counter() - t0
+                WAL_GROUP_STATS["commits"] += 1
+                WAL_GROUP_STATS["events"] += len(group.lines)
+                WAL_GROUP_STATS["flush_s"] += dt
+                if len(group.lines) > WAL_GROUP_STATS["max_events"]:
+                    WAL_GROUP_STATS["max_events"] = len(group.lines)
+                from predictionio_tpu.common import telemetry
+                if telemetry.on():
+                    reg = telemetry.registry()
+                    reg.histogram(
+                        "pio_wal_group_commit_seconds",
+                        "WAL group-commit write+flush latency").labels(
+                    ).observe(dt)
+                    reg.histogram(
+                        "pio_wal_group_commit_events",
+                        "events per WAL group commit",
+                        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
+                                 1024, 4096)).labels(
+                    ).observe(len(group.lines))
+        except BaseException as e:
+            group.finish(e)
+            raise
+        group.finish(None)
 
     def flush(self, app_id: int, channel_id: Optional[int] = None) -> None:
         with self._lock:
@@ -711,6 +897,12 @@ class EventlogEvents(Events):
         sh.wal_offset = 0
         sh.next_seq += 1
         sh.dirty = False
+        # an open commit group is superseded by the chunk we just
+        # published: its rows are durable, so its waiters ack without a
+        # WAL write (replay resolves chunk-over-WAL either way)
+        group, sh.wal_group = sh.wal_group, None
+        if group is not None and not group.done:
+            group.finish(None)
         sh.drop_stale_wals()
 
     def append_encoded(

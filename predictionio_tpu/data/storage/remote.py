@@ -460,9 +460,65 @@ def _rpc_retries():
         labelnames=("kind",))
 
 
+class _ConnectionPool:
+    """Bounded keep-alive pool of ``http.client`` connections shared by
+    every thread of the driver process.
+
+    Replaces the old one-connection-per-thread ``threading.local``: a
+    trainer with N read workers no longer parks N sockets forever, and
+    short-lived threads reuse a warm connection instead of paying TCP
+    (+TLS) setup per thread. ``acquire`` pops an idle connection or
+    dials a new one (connection COUNT is unbounded under burst — the
+    bound is on how many idle sockets are retained, so steady state
+    holds at most ``size``); ``release(reusable=False)`` — after any
+    transport error or a ``Connection: close`` reply — discards instead
+    of re-pooling, which preserves the retry semantics exactly: a retry
+    never reuses the socket that just failed."""
+
+    def __init__(self, factory, size: int):
+        self._factory = factory
+        self._size = max(1, int(size))
+        self._lock = threading.Lock()
+        self._idle: List[Any] = []
+        self.dials = 0   # connections created (reuse observability/tests)
+
+    def acquire(self):
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+            self.dials += 1
+        return self._factory()
+
+    def release(self, conn, reusable: bool = True) -> None:
+        if reusable:
+            with self._lock:
+                if len(self._idle) < self._size:
+                    self._idle.append(conn)
+                    return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
 class StorageClient:
     """props: URL (http://host:port or https://host:port)
-    [+ KEY, TIMEOUT, CAFILE, VERIFY=false].
+    [+ KEY, TIMEOUT, CAFILE, VERIFY=false, POOL].
+
+    Connections ride a bounded keep-alive pool (``POOL`` property /
+    ``PIO_RPC_POOL``, default 8 idle sockets) shared by every thread of
+    the process instead of one private connection per thread; failed
+    sockets are discarded, never re-pooled, so the retry/dedup
+    semantics below are unchanged.
 
     An https:// URL connects over TLS (the server side auto-enables TLS
     when PIO_SSL_CERTFILE is set — serve_storage inherits it via
@@ -504,7 +560,13 @@ class StorageClient:
         self.cafile = config.properties.get("CAFILE")
         self.verify = (config.properties.get(
             "VERIFY", "true").lower() != "false")
-        self._local = threading.local()
+        pool_raw = str(config.properties.get(
+            "POOL", os.environ.get("PIO_RPC_POOL", "8")))
+        try:
+            pool_size = int(pool_raw)
+        except ValueError:
+            pool_size = 8
+        self._pool = _ConnectionPool(self._new_conn, pool_size)
         self.policy = resilience.RetryPolicy.from_env(
             "PIO_RPC", properties=config.properties)
         dedup_raw = str(config.properties.get(
@@ -514,25 +576,20 @@ class StorageClient:
         self.breaker = resilience.CircuitBreaker.for_endpoint(
             f"{self.host}:{self.port}")
 
-    def _conn(self):
+    def _new_conn(self):
         import http.client
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            if self.tls:
-                import ssl
-                if self.verify:
-                    ctx = ssl.create_default_context(cafile=self.cafile)
-                else:
-                    ctx = ssl.create_default_context()
-                    ctx.check_hostname = False
-                    ctx.verify_mode = ssl.CERT_NONE
-                conn = http.client.HTTPSConnection(
-                    self.host, self.port, timeout=self.timeout, context=ctx)
+        if self.tls:
+            import ssl
+            if self.verify:
+                ctx = ssl.create_default_context(cafile=self.cafile)
             else:
-                conn = http.client.HTTPConnection(
-                    self.host, self.port, timeout=self.timeout)
-            self._local.conn = conn
-        return conn
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout, context=ctx)
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout)
 
     #: methods safe to replay after a dropped keep-alive connection; writes
     #: are NEVER transparently retried (the server may already have applied
@@ -587,7 +644,7 @@ class StorageClient:
                 ctx = tracing.current()
                 if ctx is not None:   # propagate the trace across the wire
                     hdrs = {**hdrs, tracing.TRACE_HEADER: ctx.header_value()}
-                conn = self._conn()
+                conn = self._pool.acquire()
                 conn.request(method, path, body=body, headers=hdrs)
                 if inj is not None:
                     inj.after_send("client", route)
@@ -600,18 +657,23 @@ class StorageClient:
                     chunks.append(chunk)
                 status, payload = resp.status, b"".join(chunks)
                 rheaders = {k.lower(): v for k, v in resp.getheaders()}
+                # the response is fully drained: hand the keep-alive
+                # socket back unless the server asked to close it
+                self._pool.release(conn, reusable=not resp.will_close)
+                conn = None
                 if inj is not None:
                     status, payload = inj.on_response(
                         "client", route, status, payload)
             except self._TRANSPORT_ERRORS:
                 # the connection state is unknown; drop it so the retry
-                # (or the next call) reconnects fresh
+                # (or the next call) dials fresh — a failed socket is
+                # never returned to the pool
                 if conn is not None:
                     try:
                         conn.close()
                     except Exception:
                         pass
-                self._local.conn = None
+                    conn = None
                 if self.breaker is not None:
                     self.breaker.record(False)
                 if not (idempotent
@@ -710,10 +772,7 @@ class StorageClient:
         return status, payload
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        self._pool.close()
 
 
 class RemoteEvents(Events):

@@ -91,6 +91,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # round (it should only ever shrink)
     ("lint_findings_total", "down", False),
     ("lint_suppressed_total", "down", False),
+    # ingest era (data/api/http.py + eventlog group commit): the two
+    # transport modes' 32-connection throughput, their ratio (the >= 3x
+    # contract is hard-gated by the bench's own ingest leg under
+    # BENCH_STRICT_EXTRAS=1), and the async admission p99 — trended so
+    # a transport regression is visible round over round
+    ("ingest_threaded_eps_32", "up", False),
+    ("ingest_async_eps_32", "up", False),
+    ("ingest_async_speedup_32", "up", False),
+    ("ingest_admission_p99_ms", "down", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round

@@ -761,7 +761,13 @@ def serve(api: QueryAPI, host: str = "localhost", port: int = 8000,
     CreateServer.scala:347-357). SIGTERM triggers the graceful drain:
     /readyz flips to 503, new queries get 503 + Retry-After, the batcher
     finishes every admitted in-flight batch, then the server exits —
-    the rolling-restart contract (zero dropped in-flight requests)."""
+    the rolling-restart contract (zero dropped in-flight requests).
+
+    The HTTP layer is the shared transport (data/api/http.py): the
+    query server rides whichever ``PIO_TRANSPORT`` selects — the same
+    event loop that lifted ingest throughput serves /queries.json
+    concurrency — and both transports expose the identical lifecycle
+    used below."""
     from predictionio_tpu.data.api.http import (
         install_sigterm_handler, make_server,
     )
@@ -784,4 +790,5 @@ def serve(api: QueryAPI, host: str = "localhost", port: int = 8000,
     except KeyboardInterrupt:
         pass
     server.shutdown()
+    server.server_close()
     api.close()

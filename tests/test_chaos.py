@@ -345,6 +345,52 @@ def test_defaults_wire_byte_identical(tmp_path):
         server.server_close()
 
 
+def test_connection_pool_reuses_and_bounds_sockets(tmp_path):
+    """The remote driver's keep-alive pool: sequential calls share ONE
+    dialed connection (even across threads), burst concurrency dials
+    more but retains at most the configured bound, and a transport
+    failure discards its socket instead of re-pooling it."""
+    backing = _backing(tmp_path, "memory")
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaos"))
+    backing.get_events().init(app_id)
+    server = serve_storage(backing, host="127.0.0.1", port=0)
+    try:
+        remote = _remote(server.server_address[1], POOL=2, RETRIES=2,
+                         BACKOFF_MS=1)
+        ev = remote.get_events()
+        client = ev.c
+        ev.insert(_mk("u1"), app_id)
+        for _ in range(5):
+            assert len(list(ev.find(app_id))) == 1
+        # every sequential call reused the first dialed socket — and the
+        # reuse crosses threads (the old driver parked one per thread)
+        t = threading.Thread(
+            target=lambda: list(ev.find(app_id)))
+        t.start()
+        t.join()
+        assert client._pool.dials == 1
+
+        # burst: more dials allowed, idle retention bounded by POOL
+        def call():
+            list(ev.find(app_id))
+        threads = [threading.Thread(target=call) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(client._pool._idle) <= 2
+
+        # a failed socket is never re-pooled: the injected drop forces a
+        # close + fresh dial on the retry
+        dials_before = client._pool.dials
+        resilience.install("drop:1:1@client")
+        assert len(list(ev.find(app_id))) == 1
+        assert client._pool.dials >= dials_before
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 # ---------------------------------------------------------------------------
 # query server: drain under a concurrent burst + degraded responses
 # ---------------------------------------------------------------------------

@@ -139,6 +139,44 @@ def test_batch_events(api):
     assert status == 400 and "50" in body["message"]
 
 
+def test_batch_cap_configurable(api, monkeypatch):
+    """PIO_BATCH_EVENTS_MAX raises (or lowers) the per-request item cap;
+    unset/invalid keeps the reference default of 50."""
+    q = {"accessKey": "secret"}
+    items = [{"event": "e", "entityType": "user", "entityId": f"x{k}"}
+             for k in range(51)]
+    body = json.dumps(items).encode()
+    monkeypatch.setenv("PIO_BATCH_EVENTS_MAX", "100")
+    status, results = api.handle("POST", "/batch/events.json", q, body)
+    assert status == 200 and len(results) == 51
+    assert all(r["status"] == 201 for r in results)
+    monkeypatch.setenv("PIO_BATCH_EVENTS_MAX", "2")
+    status, payload = api.handle("POST", "/batch/events.json", q,
+                                 json.dumps(items[:3]).encode())
+    assert status == 400 and "2" in payload["message"]
+    monkeypatch.setenv("PIO_BATCH_EVENTS_MAX", "junk")
+    status, payload = api.handle("POST", "/batch/events.json", q, body)
+    assert status == 400 and "50" in payload["message"]
+
+
+def test_batch_bulk_and_per_item_paths_agree(api, monkeypatch):
+    """PIO_BATCH_BULK_INSERT=0 (the per-item legacy path) produces the
+    same per-item statuses, in order, as the default bulk path."""
+    q = {"accessKey": "secret"}
+    items = [
+        {"event": "rate", "entityType": "user", "entityId": "a"},
+        {"event": "rate"},                       # malformed -> 400
+        {"event": "buy", "entityType": "user", "entityId": "b"},
+    ]
+    body = json.dumps(items).encode()
+    status, bulk = api.handle("POST", "/batch/events.json", q, body)
+    monkeypatch.setenv("PIO_BATCH_BULK_INSERT", "0")
+    status2, per_item = api.handle("POST", "/batch/events.json", q, body)
+    assert status == status2 == 200
+    assert [r["status"] for r in bulk] == [r["status"] for r in per_item] \
+        == [201, 400, 201]
+
+
 def test_channel_auth_and_separation(api, memory_storage):
     cid = memory_storage.get_meta_data_channels().insert(
         Channel(0, "mobile", api.app_id))
