@@ -1192,6 +1192,224 @@ def _shard_hbm_ceiling_demo():
     return out
 
 
+def measure_serve_quant(storage, engine, n_conns: int = 8,
+                        queries_per_client: int = 100):
+    """Quantized-serving leg (ops/quant.py): the same batched HTTP path
+    with serve-quant off (fp32) vs forced on (int8 per-row-scale
+    factors + the fused kernel wherever PIO_SERVE_FUSED resolves it),
+    plus a sequential probe set whose RANKINGS are compared between the
+    two servers — bit-parity is off the table for int8, so the wire
+    evidence is recall@k and exact-match@1 (the KNOWN_ISSUES #12
+    ranking-parity contract).
+
+    Gates under BENCH_STRICT_EXTRAS=1: quantized p99 <= the fp32 p99
+    (absolute floor 0.2 ms like the telemetry/waterfall legs — int8
+    halves the bandwidth bill, it must never cost latency),
+    factor-matrix HBM ratio <= 0.30 (the int8 matrices vs fp32; the
+    fp32 per-row scale vectors are reported next to it as
+    `with_scales_ratio` — at rank 64 they are ~2% noise, at the bench's
+    rank 10 they are visible, which is why the gate names the
+    matrices), and recall@k >= 0.99. Also records the quantized
+    HBM-ceiling demonstration (~4x the fp32 sharded catalog)."""
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+    k_probe = 10
+    probes = [json.dumps({"user": f"u{(7 * i) % 1000}", "num": k_probe})
+              for i in range(32)]
+
+    def leg(quant_mode: str):
+        api = QueryAPI(storage=storage, engine=engine,
+                       config=ServerConfig(batching="on",
+                                           serve_quant=quant_mode))
+        server = make_server(api, "127.0.0.1", 0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        lat_lock = threading.Lock()
+        lat: list = []
+        errors: list = []
+        barrier = threading.Barrier(n_conns + 1)
+
+        def client(cx):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.connect()
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                my = []
+                barrier.wait()
+                for q in range(queries_per_client):
+                    body = json.dumps(
+                        {"user": f"u{(cx * 131 + q * 17) % 1000}",
+                         "num": 10})
+                    t0 = time.perf_counter()
+                    conn.request(
+                        "POST", "/queries.json", body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    my.append(time.perf_counter() - t0)
+                    assert resp.status == 200, payload[:200]
+                conn.close()
+                with lat_lock:
+                    lat.extend(my)
+            except Exception as e:
+                errors.append(e)
+
+        try:
+            # sequential probe set first: the ranking-parity evidence
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            conn.connect()
+            rankings = []
+            for p in probes:
+                conn.request("POST", "/queries.json", body=p,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+                assert resp.status == 200, payload[:200]
+                scores = json.loads(payload).get("itemScores") or []
+                rankings.append([s["item"] for s in scores])
+            conn.close()
+            threads = [threading.Thread(target=client, args=(cx,))
+                       for cx in range(n_conns)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+            status = api.handle("GET", "/")[1]
+            quant_info = status.get("quant") or {}
+            model = api.models[0]
+        finally:
+            server.shutdown()
+            api.close()
+        lat_ms = np.asarray(lat) * 1e3
+        return {"p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                }, rankings, quant_info, model
+
+    # pin BOTH legs to device-resident serving like the sharded leg:
+    # the overhead gate must compare like with like
+    prior_probe = os.environ.get("PIO_SERVE_DEVICE_MS")
+    os.environ["PIO_SERVE_DEVICE_MS"] = "1e9"
+    try:
+        off, rank_off, _info_off, model_off = leg("off")
+        on, rank_on, quant_info, _model_on = leg("on")
+    finally:
+        if prior_probe is None:
+            os.environ.pop("PIO_SERVE_DEVICE_MS", None)
+        else:
+            os.environ["PIO_SERVE_DEVICE_MS"] = prior_probe
+
+    # ranking parity AT THE WIRE: recall@k + exact-match@1 over the
+    # probe set (empty answers — unknown users — agree trivially and
+    # are excluded from the mean so they can't inflate recall)
+    recalls, exact1 = [], []
+    for a, b in zip(rank_off, rank_on):
+        if not a and not b:
+            continue
+        k = max(len(a), 1)
+        recalls.append(len(set(a) & set(b)) / k)
+        exact1.append(1.0 if (a and b and a[0] == b[0]) else 0.0)
+    recall = float(np.mean(recalls)) if recalls else None
+    em1 = float(np.mean(exact1)) if exact1 else None
+
+    # factor-matrix HBM bytes: the int8 matrices vs their fp32
+    # equivalents, scales reported alongside (model_io accounting)
+    n_u, rank = (int(d) for d in np.shape(model_off.user_factors))
+    n_i = int(np.shape(model_off.item_factors)[0])
+    fp32_bytes = (n_u + n_i) * rank * 4
+    int8_matrix_bytes = (n_u + n_i) * rank
+    scale_bytes = (n_u + n_i) * 4
+    hbm_ratio = int8_matrix_bytes / fp32_bytes
+    with_scales_ratio = (int8_matrix_bytes + scale_bytes) / fp32_bytes
+
+    quant_active = bool(quant_info.get("enabled"))
+    p99_ok = (on["p99_ms"] <= off["p99_ms"]
+              or on["p99_ms"] - off["p99_ms"] <= 0.2)
+    recall_ok = recall is not None and recall >= 0.99
+    return {
+        "serve_quant_off": off,
+        "serve_quant_on": on,
+        "serve_quant_p99_ms": on["p99_ms"],
+        "serve_quant_p99_ok": bool(p99_ok),
+        "serve_quant_active": quant_active,
+        "serve_quant_info": quant_info,
+        "serve_quant_hbm_ratio": round(hbm_ratio, 4),
+        "serve_quant_hbm_ratio_with_scales": round(with_scales_ratio, 4),
+        "serve_quant_hbm_ok": bool(hbm_ratio <= 0.30),
+        "serve_quant_fp32_bytes": fp32_bytes,
+        "serve_quant_int8_bytes": int8_matrix_bytes + scale_bytes,
+        "serve_quant_recall": (round(recall, 4)
+                               if recall is not None else None),
+        "serve_quant_exact1": (round(em1, 4) if em1 is not None else None),
+        "serve_quant_recall_ok": bool(recall_ok),
+        "serve_quant_hbm_ceiling": _quant_hbm_ceiling_demo(),
+    }
+
+
+def _quant_hbm_ceiling_demo():
+    """The quantized half of the HBM-ceiling story: a catalog sized so
+    even the SHARDED fp32 layout busts the per-device demonstration
+    budget (``BENCH_SHARD_BUDGET_MB``, same budget as
+    ``_shard_hbm_ceiling_demo``) — roughly 4x the catalog the fp32 mesh
+    ceiling allows — while the int8 shards fit with room to spare, and
+    the quantized sharded top-k actually answers. Honestly skipped on
+    1-device hosts (nothing to shard)."""
+    import jax
+
+    from predictionio_tpu.ops import quant as quant_mod
+    from predictionio_tpu.parallel import serve_dist
+
+    devs = jax.devices()
+    n_dev = len(devs)
+    budget = int(float(os.environ.get("BENCH_SHARD_BUDGET_MB", "64"))
+                 * 2**20)
+    out = {"budget_bytes": budget, "n_devices": n_dev}
+    if n_dev < 2:
+        out["skipped"] = "single-device mesh - nothing to split"
+        return out
+    rank = 64
+    # catalog at ~3.5x the fp32 sharded ceiling (the ideal int8 gain is
+    # 4x; the fp32 per-row scale vectors trim it to (4r)/(r+4) = 3.76x
+    # at rank 64): fp32 per-shard lands at ~3.5x the budget — far past
+    # the fp32 ceiling — while the int8 shards fit at ~0.93x of it
+    n_items = int(budget * 3.5) * n_dev // (rank * 4)
+    n_users = 1024
+    rng = np.random.default_rng(0)
+    U = rng.standard_normal((n_users, rank), dtype=np.float32)
+    V = rng.standard_normal((n_items, rank), dtype=np.float32)
+    fp32_per_shard = -(-n_items // n_dev) * rank * 4
+    t0 = time.perf_counter()
+    qf = quant_mod.QuantizedFactors.from_factors(U, V)
+    sharded = serve_dist.shard_factors(U, V, quant=qf)
+    per_shard = sharded.per_shard_bytes()
+    vals, idx = jax.device_get(
+        sharded.topk(np.arange(8, dtype=np.int32), 10))
+    served_ok = (bool(np.isfinite(vals).all())
+                 and bool((idx >= 0).all())
+                 and bool((idx < n_items).all()))
+    fp32_ceiling_items = budget * n_dev // (rank * 4)
+    out.update({
+        "rank": rank, "n_items": n_items, "n_users": n_users,
+        "fp32_per_shard_bytes": fp32_per_shard,
+        "int8_per_shard_bytes": per_shard,
+        "fp32_sharded_fits_budget": bool(fp32_per_shard <= budget),
+        "int8_sharded_fits_budget": bool(per_shard <= budget),
+        "catalog_vs_fp32_ceiling": round(
+            n_items / max(fp32_ceiling_items, 1), 2),
+        "quant_sharded_served_ok": served_ok,
+        "shard_and_serve_s": round(time.perf_counter() - t0, 3),
+    })
+    return out
+
+
 def measure_recompile_watch(storage, engine, warmup_queries: int = 24,
                             steady_queries: int = 48):
     """Recompile-watchdog leg (common/devicewatch.py): deploy the engine
@@ -1623,6 +1841,18 @@ def main() -> None:
                 shard_leg = {"serve_sharded_error":
                              f"{type(e).__name__}: {e}"}
 
+        # quantized-serving leg (ops/quant.py): fp32 vs int8(+fused)
+        # p99, factor-matrix HBM ratio, and wire-level ranking parity
+        # (recall@k / exact-match@1); strict gates: quant p99 <= fp32,
+        # hbm_ratio <= 0.30, recall >= 0.99
+        quant_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                quant_leg = measure_serve_quant(storage, engine)
+            except Exception as e:
+                quant_leg = {"serve_quant_error":
+                             f"{type(e).__name__}: {e}"}
+
         # recompile-watchdog leg (common/devicewatch.py): after a warmup
         # burst the standard bucketed serving path must compile NOTHING —
         # a nonzero count is the padding-bucket p99 cliff, strict-fatal
@@ -1765,6 +1995,7 @@ def main() -> None:
                 **(telem or {}),
                 **(wf or {}),
                 **(shard_leg or {}),
+                **(quant_leg or {}),
                 **(recompile_watch or {}),
                 **(eval_grid or {}),
                 **(ecom or {}),
@@ -1911,6 +2142,44 @@ def main() -> None:
                     failures.append(
                         "HBM-ceiling leg: the oversized factor matrix "
                         "did not serve in sharded mode with "
+                        "BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and quant_leg:
+            if quant_leg.get("serve_quant_error"):
+                failures.append(
+                    f"quantized-serving leg crashed "
+                    f"({quant_leg['serve_quant_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            elif not quant_leg.get("serve_quant_active"):
+                failures.append(
+                    "serve-quant=on deploy fell back to fp32 (the "
+                    "quantized layout or its recall probe failed) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                if not quant_leg.get("serve_quant_recall_ok"):
+                    failures.append(
+                        "quantized serving recall@k "
+                        f"({quant_leg.get('serve_quant_recall')}) below "
+                        "the 0.99 ranking-parity contract with "
+                        "BENCH_STRICT_EXTRAS=1")
+                if not quant_leg.get("serve_quant_p99_ok"):
+                    failures.append(
+                        "quantized p99 "
+                        f"({quant_leg['serve_quant_on']['p99_ms']} ms) "
+                        "exceeds the fp32 path "
+                        f"({quant_leg['serve_quant_off']['p99_ms']} ms) "
+                        "with BENCH_STRICT_EXTRAS=1")
+                if not quant_leg.get("serve_quant_hbm_ok"):
+                    failures.append(
+                        "quantized factor matrices measure "
+                        f"{quant_leg.get('serve_quant_hbm_ratio')}x the "
+                        "fp32 HBM bytes (> 0.30) with "
+                        "BENCH_STRICT_EXTRAS=1")
+                ceiling = quant_leg.get("serve_quant_hbm_ceiling") or {}
+                if (not ceiling.get("skipped")
+                        and not ceiling.get("quant_sharded_served_ok")):
+                    failures.append(
+                        "quantized HBM-ceiling leg: the 3.5x catalog "
+                        "did not serve int8-sharded with "
                         "BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and \
                 recompile_watch is not None:

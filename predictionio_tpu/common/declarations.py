@@ -129,6 +129,23 @@ ENV_VARS: Dict[str, str] = {
     "PIO_SERVE_SHARD":
         "row-sharded serving over the device mesh: 1/0 overrides "
         "`pio deploy --shard-serving auto`",
+    "PIO_SERVE_QUANT":
+        "quantized serving from int8 factor matrices with per-row fp32 "
+        "scales: 1/0 overrides `pio deploy --serve-quant auto` (auto = "
+        "accelerator backends only, gated by the deploy-time recall "
+        "probe; off = the bit-compatible fp32 path)",
+    "PIO_SERVE_QUANT_RECALL_MIN":
+        "recall@k floor below which auto-mode quantized serving falls "
+        "back to fp32 at deploy time (default 0.99 — the KNOWN_ISSUES "
+        "#12 ranking-parity contract)",
+    "PIO_SERVE_FUSED":
+        "fused Pallas score->mask->top-k kernel for quantized serving: "
+        "auto (default, TPU backends only) | 1/on (everywhere — "
+        "interpreter mode off-TPU, slow but bit-equivalent) | 0/off "
+        "(the XLA fallback kernel)",
+    "PIO_SERVE_FUSED_TILE":
+        "item-axis tile of the fused quantized top-k kernel "
+        "(default 512 lanes)",
     "PIO_SERVE_WARMUP_FLUSHES":
         "flush count that ends the recompile watchdog's warmup when no "
         "explicit AOT-complete mark arrives (default 32)",
@@ -246,6 +263,14 @@ METRICS: Dict[str, str] = {
         "per-stage waterfall latency (admission/supplement/dispatch/pad/"
         "execute/merge/serialize) with trace-id exemplars",
     "pio_serve_shards": "live shard count of the sharded serving path",
+    "pio_serve_quant_mode":
+        "1 while the deployed factors serve quantized (int8 + scales)",
+    "pio_serve_factor_bytes":
+        "deployed factor-matrix bytes by dtype (live footprint vs its "
+        "fp32 equivalent)",
+    "pio_serve_quant_recall":
+        "deploy-time ranking-parity probe of the quantized path vs fp32 "
+        "(recall@k / exact-match@1)",
     "pio_degraded_batches_total":
         "flushes tainted by a failed side-channel lookup",
     "pio_degraded_queries_upper_bound":

@@ -198,6 +198,20 @@ def note_sharding(summary: Optional[Dict[str, Any]]) -> None:
         _sharding_state = dict(summary) if summary is not None else None
 
 
+#: most recent quantized-serving state (ops/quant.py via note_quant);
+#: /debug/device.json and `pio doctor`'s quant line read it
+_quant_state: Optional[Dict[str, Any]] = None
+
+
+def note_quant(summary: Optional[Dict[str, Any]]) -> None:
+    """Record (or with None, clear) the deploy's quantized-serving
+    state (mode, factor bytes fp32 -> int8, last recall-gate value,
+    fell-back flag) for the debug surface."""
+    global _quant_state
+    with _lock:
+        _quant_state = dict(summary) if summary is not None else None
+
+
 def serving_warmup_done() -> bool:
     with _lock:
         return _warmup_done
@@ -483,6 +497,8 @@ def debug_snapshot() -> Dict[str, Any]:
         aot_state = dict(_aot_state) if _aot_state is not None else None
         sharding_state = (dict(_sharding_state)
                           if _sharding_state is not None else None)
+        quant_state = (dict(_quant_state)
+                       if _quant_state is not None else None)
     watchdog["compilesTotal"] = compiles_total()
     watchdog["postWarmupRecompiles"] = post_warmup_recompiles()
     with CircuitBreaker._registry_lock:
@@ -493,6 +509,7 @@ def debug_snapshot() -> Dict[str, Any]:
         "watchdog": watchdog,
         "aot": aot_state,
         "sharding": sharding_state,
+        "quant": quant_state,
         "devices": _device_stats(),
         "liveArrays": _live_array_stats(),
         "compileCache": {"dir": compile_cache_dir(),

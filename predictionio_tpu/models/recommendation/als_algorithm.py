@@ -51,13 +51,26 @@ class ALSModel:
     PADDED sharded device arrays. Persisted blobs never carry it —
     serialization happens on the train output, where it is None — and
     loaders of pre-sharding pickles simply lack the attribute, hence
-    the defensive ``getattr(model, "sharding", None)`` at every read."""
+    the defensive ``getattr(model, "sharding", None)`` at every read.
+
+    ``quant`` is the same shape of serve-time-only state for the
+    QUANTIZED replicated layout (ops/quant.py QuantizedServing: device
+    int8 factor blocks + fp32 per-row scales + the dequantize-free
+    top-k programs). When set, ``user_factors``/``item_factors`` stay
+    HOST fp32 numpy — the whole point is that no fp32 device copy
+    exists; the eval/batch_predict paths keep reading the host arrays.
+    A sharded AND quantized deploy carries the int8 layout inside
+    ``sharding`` (ShardedFactors.dtype == "int8") with ``quant``
+    None. /reload re-quantizes on load, so persisted blobs never carry
+    either; pre-quant pickles lack the attribute, hence the defensive
+    ``getattr(model, "quant", None)`` at every read."""
     rank: int
     user_factors: "np.ndarray"   # (n_users, rank)
     item_factors: "np.ndarray"   # (n_items, rank)
     user_vocab: BiMap
     item_vocab: BiMap
     sharding: Optional[object] = None
+    quant: Optional[object] = None
 
     def __str__(self) -> str:
         return (f"ALSModel(rank={self.rank}, users={len(self.user_vocab)}, "
@@ -277,15 +290,27 @@ class ALSAlgorithm(Algorithm):
     def prepare_serving(self, model: ALSModel) -> ALSModel:
         """Pick the serving path by MEASURING the deployed device.
 
-        Sharded first (parallel/serve_dist.py): when the deploy scope
+        Quantization first (ops/quant.py): when the deploy scope
+        resolves serve-quant on (`pio deploy --serve-quant`,
+        PIO_SERVE_QUANT), both factor matrices are quantized to int8
+        with per-row fp32 scales and the deploy-time ranking-parity
+        probe runs against the fp32 factors; "auto" refuses the
+        quantized layout (and records why) when recall@k misses the
+        floor. The quantized blocks then ride whichever layout wins
+        below — sharded (int8 shards + sharded scale vectors) or
+        replicated (QuantizedServing) — so sharding x quantization
+        compose. A failed quantization degrades to fp32 serving, never
+        to a dead deploy.
+
+        Sharded next (parallel/serve_dist.py): when the deploy scope
         resolves shard-serving on (`pio deploy --shard-serving`,
-        PIO_SERVE_SHARD), both factor matrices are laid out row-sharded
+        PIO_SERVE_SHARD), the factor blocks are laid out row-sharded
         over the mesh and every query serves from the per-device local
         top-k + merge kernel — the per-device HBM footprint drops to
         total/n_dev, which is what lets a factor matrix larger than one
         chip serve at all. Results are bit-identical to the replicated
-        path. A failed shard layout degrades to the replicated probe
-        below, never to a dead deploy.
+        path (within the same dtype). A failed shard layout degrades to
+        the replicated probe below, never to a dead deploy.
 
         Otherwise: device-resident replicated serving (one fused
         dispatch per query, topk.topk_for_user) wins on a locally-
@@ -296,18 +321,42 @@ class ALSAlgorithm(Algorithm):
         host numpy (loaded blob) — and keep whichever layout serves
         faster (threshold PIO_SERVE_DEVICE_MS, default 3 ms). No
         reference analogue — MLlib serving is always JVM-host-side."""
+        import logging
         import os
         import time
 
         import jax
 
+        from predictionio_tpu.ops import quant as quant_mod
         from predictionio_tpu.parallel import serve_dist
+
+        log = logging.getLogger("predictionio_tpu.recommendation")
+        qf = None
+        if quant_mod.serving_enabled():
+            try:
+                U = np.asarray(model.user_factors)
+                V = np.asarray(model.item_factors)
+                qf = quant_mod.QuantizedFactors.from_factors(U, V)
+                parity = quant_mod.ranking_parity(U, V, qf)
+                qf.recall = parity["recall"]
+                qf.exact1 = parity["exact1"]
+                if not quant_mod.accept_parity(parity):
+                    log.warning(
+                        "quantized serving refused by the ranking-parity "
+                        "probe (recall@%d=%.4f < %.2f floor; "
+                        "KNOWN_ISSUES #12); serving fp32",
+                        parity["k"], parity["recall"],
+                        quant_mod.recall_floor())
+                    qf = None
+            except Exception:
+                log.exception("factor quantization failed; serving fp32")
+                qf = None
 
         if serve_dist.serving_enabled():
             try:
                 sharded = serve_dist.shard_factors(
                     np.asarray(model.user_factors),
-                    np.asarray(model.item_factors))
+                    np.asarray(model.item_factors), quant=qf)
                 return ALSModel(
                     rank=model.rank,
                     user_factors=sharded.user_shards,
@@ -316,11 +365,26 @@ class ALSAlgorithm(Algorithm):
                     item_vocab=model.item_vocab,
                     sharding=sharded)
             except Exception:
-                import logging
-                logging.getLogger(
-                    "predictionio_tpu.recommendation").exception(
+                log.exception(
                     "sharded serving layout failed; falling back to "
                     "replicated serving")
+
+        if qf is not None:
+            try:
+                qs = quant_mod.QuantizedServing.build(qf)
+                # factors stay HOST fp32: the int8 blocks are the only
+                # device copy (the 4x footprint win), and the eval
+                # paths keep their host BLAS
+                return ALSModel(
+                    rank=model.rank,
+                    user_factors=np.asarray(model.user_factors),
+                    item_factors=np.asarray(model.item_factors),
+                    user_vocab=model.user_vocab,
+                    item_vocab=model.item_vocab,
+                    quant=qs)
+            except Exception:
+                log.exception("quantized serving layout failed; "
+                              "falling back to fp32 serving")
 
         try:
             U = jax.device_put(np.asarray(model.user_factors))
@@ -367,7 +431,15 @@ class ALSAlgorithm(Algorithm):
         == 0` holds with sharding on. Sharded programs are mesh-
         topology-specific, so the declared train-time export does not
         enumerate them; the deploy-side prebuild owns them (the
-        persistent compile cache still amortizes them per machine)."""
+        persistent compile cache still amortizes them per machine).
+
+        A QUANTIZED replicated model enumerates the (bucket x k)
+        quantized programs (fused Pallas or XLA fallback, whichever the
+        deploy resolved) plus the per-k inline quant programs, so
+        `post_warmup_recompiles == 0` holds with quant (+fused) on.
+        Quant programs depend on the deploy environment's mode/fused
+        resolution, so — like sharded — the declared train-time export
+        skips them."""
         from predictionio_tpu.serving import aot
 
         sharding = getattr(model, "sharding", None)
@@ -376,6 +448,12 @@ class ALSAlgorithm(Algorithm):
 
             return serve_dist.sharded_program_specs(
                 sharding, buckets, aot.serving_ks(sharding.n_items))
+        quant = getattr(model, "quant", None)
+        if quant is not None and not declared:
+            from predictionio_tpu.ops import quant as quant_mod
+
+            return quant_mod.quant_program_specs(
+                quant, buckets, aot.serving_ks(quant.n_items))
         if not declared and isinstance(model.user_factors, np.ndarray):
             return ()
 
@@ -400,6 +478,7 @@ class ALSAlgorithm(Algorithm):
             # error (lax.top_k rejects negative k)
             return PredictedResult(())
         sharding = getattr(model, "sharding", None)
+        quant = getattr(model, "quant", None)
         if sharding is not None:
             import jax
 
@@ -409,6 +488,14 @@ class ALSAlgorithm(Algorithm):
             vals, idx = jax.device_get(sharding.topk(
                 np.asarray([user_ix], dtype=np.int32), k))
             vals, idx = vals[0], idx[0]
+        elif quant is not None:
+            import jax
+
+            # inline quantized serve: the per-k program
+            # quant_program_specs prebuilds for exactly this path;
+            # bit-identical to a row of the batched quant kernels
+            vals, idx = jax.device_get(quant.topk_one(
+                np.int32(user_ix), k))
         elif isinstance(model.user_factors, np.ndarray):
             # host serving: one BLAS matvec + argpartition
             scores = model.item_factors @ model.user_factors[user_ix]
@@ -448,6 +535,7 @@ class ALSAlgorithm(Algorithm):
         ixs = np.asarray([ix for _qx, _q, ix in valid], dtype=np.int32)
         from predictionio_tpu.common import waterfall
         sharding = getattr(model, "sharding", None)
+        quant = getattr(model, "quant", None)
         if sharding is not None:
             from predictionio_tpu.serving.protocol import bucket_for
             import jax
@@ -467,6 +555,27 @@ class ALSAlgorithm(Algorithm):
             with waterfall.stage("execute"):
                 vals, idx = jax.device_get(sharding.topk(pix, k))
             waterfall.note("shards", sharding.n_shards)
+            rows = [(vals[r, :min(q.num, k)], idx[r, :min(q.num, k)])
+                    for r, (_qx, q, _ix) in enumerate(valid)]
+        elif quant is not None:
+            from predictionio_tpu.serving.protocol import bucket_for
+            import jax
+
+            # quantized device path (ops/quant.py): the same
+            # pad-to-bucket prep, then ONE dequantize-free dispatch —
+            # int8 x int8 scores + fused rescale + top-k (the fused
+            # Pallas kernel when the deploy resolved it) — ending in
+            # the host transfer of the (bucket, k) result
+            # (KNOWN_ISSUES #3). The quant note turns "execute is
+            # slow" into "it's the int8 path", one hop from
+            # /debug/slow.json.
+            with waterfall.stage("pad"):
+                bucket = bucket_for(len(valid))
+                pix = np.zeros(bucket, dtype=np.int32)
+                pix[:len(valid)] = ixs
+            with waterfall.stage("execute"):
+                vals, idx = jax.device_get(quant.topk(pix, k))
+            waterfall.note("quant", "int8")
             rows = [(vals[r, :min(q.num, k)], idx[r, :min(q.num, k)])
                     for r, (_qx, q, _ix) in enumerate(valid)]
         elif isinstance(model.user_factors, np.ndarray):

@@ -221,6 +221,72 @@ def topk_for_users_sharded(
     )(user_shards, item_shards, user_ixs)
 
 
+@partial(jax.jit, static_argnames=("k", "n_items", "rows_dev_u",
+                                   "rows_dev_i", "mesh"))
+def topk_for_users_sharded_quant(
+    user_shards: jnp.ndarray,    # (n_dev * rows_dev_u, r) int8, sharded
+    user_scales: jnp.ndarray,    # (n_dev * rows_dev_u,) fp32, sharded
+    item_shards: jnp.ndarray,    # (n_dev * rows_dev_i, r) int8, sharded
+    item_scales: jnp.ndarray,    # (n_dev * rows_dev_i,) fp32, sharded
+    user_ixs: jnp.ndarray,       # (b,) int32 global user ids, replicated
+    *,
+    k: int,
+    n_items: int,
+    rows_dev_u: int,
+    rows_dev_i: int,
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Row-sharded QUANTIZED top-k serve (ops/quant.py factors): the
+    same shard/merge shape as :func:`topk_for_users_sharded`, with the
+    local scores computed as exact int8 x int8 -> int32 dot products
+    plus the fused per-row rescale. Because the integer arithmetic is
+    exact and the rescale elementwise, the result is BIT-IDENTICAL
+    (values, indices, ties) to the replicated quantized kernels —
+    there is no accumulation-order drift for sharding to introduce."""
+    axis = mesh.axis_names[0]
+    b = user_ixs.shape[0]
+    k_local = min(int(k), int(rows_dev_i))
+
+    def step(U_blk, su_blk, V_blk, sv_blk, ixs):
+        d = lax.axis_index(axis)
+        # 1. replicate the batch's quantized user rows + scales: the
+        # owning device contributes, the psum fills in exact zeros —
+        # integer adds for the int8 rows (widened to int32: psum over
+        # int8 would wrap at 127), so Q is exactly the replicated gather
+        loc = jnp.clip(ixs - d * rows_dev_u, 0, rows_dev_u - 1)
+        own = ((ixs - d * rows_dev_u >= 0)
+               & (ixs - d * rows_dev_u < rows_dev_u))
+        Qi = jnp.take(U_blk, loc, axis=0).astype(jnp.int32)
+        Q = lax.psum(Qi * own[:, None].astype(jnp.int32), axis)
+        su = lax.psum(jnp.take(su_blk, loc, axis=0)
+                      * own.astype(sv_blk.dtype), axis)
+        # 2. local int32 scores over the local int8 item shard (exact),
+        # then the same elementwise rescale as the replicated kernels
+        s32 = lax.dot_general(Q, V_blk.astype(jnp.int32),
+                              (((1,), (1,)), ((), ())))
+        scores = s32.astype(jnp.float32) * (su[:, None]
+                                            * sv_blk[None, :])
+        gid = d * rows_dev_i + lax.broadcasted_iota(
+            jnp.int32, (b, rows_dev_i), 1)
+        scores = jnp.where(gid < n_items, scores, NEG_INF)
+        # 3.+4. local top-k + all-gather merge: identical to the fp32
+        # sharded kernel (the tie rule and candidate-coverage argument
+        # carry over unchanged)
+        neg, sid = lax.sort((-scores, gid), num_keys=2, dimension=-1)
+        cand_v = lax.all_gather(-neg[:, :k_local], axis, axis=1,
+                                tiled=True)
+        cand_g = lax.all_gather(sid[:, :k_local], axis, axis=1,
+                                tiled=True)
+        mneg, mg = lax.sort((-cand_v, cand_g), num_keys=2, dimension=-1)
+        return -mneg[:, :k], mg[:, :k]
+
+    return shard_map_compat(
+        step, mesh,
+        (P(axis, None), P(axis), P(axis, None), P(axis), P()),
+        (P(), P()),
+    )(user_shards, user_scales, item_shards, item_scales, user_ixs)
+
+
 # ---------------------------------------------------------------------------
 # layout: canonical factors -> row-sharded device arrays
 # ---------------------------------------------------------------------------
@@ -248,7 +314,13 @@ def _shard_rows(arr: np.ndarray, rows_dev: int, spec: NamedSharding):
 class ShardedFactors:
     """One model's factors laid out for sharded serving, plus the jit
     statics its programs need. ``topk`` is the drop-in replacement for
-    the replicated ``topk_for_users(U, V, ixs, k)`` call."""
+    the replicated ``topk_for_users(U, V, ixs, k)`` call.
+
+    ``dtype`` records the shard element type: "float32" (the PR 8
+    layout) or "int8" when ``shard_factors`` was handed quantized
+    factors (ops/quant.py) — then ``user_scales``/``item_scales`` hold
+    the row-sharded fp32 scale vectors and ``topk`` dispatches the
+    quantized shard_map kernel."""
     mesh: Mesh
     n_users: int
     n_items: int
@@ -257,6 +329,11 @@ class ShardedFactors:
     rows_dev_i: int
     user_shards: Any
     item_shards: Any
+    user_scales: Any = None
+    item_scales: Any = None
+    dtype: str = "float32"
+    quant_recall: Optional[float] = None
+    quant_exact1: Optional[float] = None
 
     @property
     def n_shards(self) -> int:
@@ -264,12 +341,23 @@ class ShardedFactors:
 
     def per_shard_bytes(self) -> int:
         """Per-device factor bytes (padded rows included) — the number
-        the HBM-ceiling story is about: total/n_dev instead of total."""
-        itemsize = 4  # float32 serving factors
-        return (self.rows_dev_u + self.rows_dev_i) * self.rank * itemsize
+        the HBM-ceiling story is about: total/n_dev instead of total.
+        Quantized shards count 1 byte per element plus their fp32
+        per-row scales."""
+        rows = self.rows_dev_u + self.rows_dev_i
+        if self.dtype == "int8":
+            return rows * self.rank + rows * 4
+        return rows * self.rank * 4
 
     def topk(self, user_ixs, k: int):
         ixs = np.asarray(user_ixs, dtype=np.int32)
+        if self.dtype == "int8":
+            return topk_for_users_sharded_quant(
+                self.user_shards, self.user_scales,
+                self.item_shards, self.item_scales, ixs,
+                k=int(k), n_items=self.n_items,
+                rows_dev_u=self.rows_dev_u, rows_dev_i=self.rows_dev_i,
+                mesh=self.mesh)
         return topk_for_users_sharded(
             self.user_shards, self.item_shards, ixs,
             k=int(k), n_items=self.n_items,
@@ -277,23 +365,46 @@ class ShardedFactors:
             mesh=self.mesh)
 
     def summary(self) -> Dict[str, Any]:
-        return {
+        out = {
             "shards": self.n_shards,
             "merge": MERGE_STRATEGY,
             "rowsPerShard": {"users": self.rows_dev_u,
                              "items": self.rows_dev_i},
             "perShardFactorBytes": self.per_shard_bytes(),
         }
+        if self.dtype == "int8":
+            # only on quantized layouts: fp32 sharded deploys keep the
+            # exact PR 8 key set (wire parity on GET /)
+            out["dtype"] = self.dtype
+        return out
+
+    def quant_summary(self) -> Dict[str, Any]:
+        """The quant block of a sharded int8 layout (GET / "quant"
+        section + ops/quant.summarize_deploy)."""
+        rows = self.n_users + self.n_items
+        return {
+            "dtype": "int8",
+            "shards": self.n_shards,
+            "int8Bytes": rows * self.rank + rows * 4,
+            "fp32Bytes": rows * self.rank * 4,
+            "recall": self.quant_recall,
+            "exact1": self.quant_exact1,
+        }
 
 
 def shard_factors(user_factors, item_factors,
                   n_shards: Optional[int] = None,
-                  mesh: Optional[Mesh] = None) -> ShardedFactors:
+                  mesh: Optional[Mesh] = None,
+                  quant: Optional[Any] = None) -> ShardedFactors:
     """Lay a model's factor matrices out row-sharded for serving.
 
     Default mesh: all visible devices on a fresh 1-D "shard" axis.
-    Records the ``pio_serve_shards`` gauge and the /debug/device.json
-    sharding block so `pio doctor` can see the layout."""
+    ``quant`` (an ops/quant.QuantizedFactors) shards the int8 blocks
+    and their fp32 per-row scale vectors instead of the fp32 matrices —
+    the sharded AND quantized layout, per-device footprint
+    ~total/(4·n_dev). Records the ``pio_serve_shards`` gauge and the
+    /debug/device.json sharding block so `pio doctor` can see the
+    layout."""
     if mesh is None:
         devices = jax.devices()
         if n_shards is not None:
@@ -305,22 +416,36 @@ def shard_factors(user_factors, item_factors,
         mesh = Mesh(np.asarray(devices), (AXIS,))
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
-    U = np.asarray(user_factors, dtype=np.float32)
-    V = np.asarray(item_factors, dtype=np.float32)
+    if quant is not None:
+        U, V = quant.u_q, quant.v_q
+    else:
+        U = np.asarray(user_factors, dtype=np.float32)
+        V = np.asarray(item_factors, dtype=np.float32)
     n_users, rank = U.shape
     n_items = V.shape[0]
     rows_u = _rows_dev(n_users, n_dev)
     rows_i = _rows_dev(n_items, n_dev)
     row_spec = NamedSharding(mesh, P(axis, None))
+    extra: Dict[str, Any] = {}
+    if quant is not None:
+        vec_spec = NamedSharding(mesh, P(axis))
+        extra = {
+            "user_scales": _shard_rows(quant.u_scale, rows_u, vec_spec),
+            "item_scales": _shard_rows(quant.v_scale, rows_i, vec_spec),
+            "dtype": "int8",
+            "quant_recall": quant.recall,
+            "quant_exact1": quant.exact1,
+        }
     sharded = ShardedFactors(
         mesh=mesh, n_users=n_users, n_items=n_items, rank=rank,
         rows_dev_u=rows_u, rows_dev_i=rows_i,
         user_shards=_shard_rows(U, rows_u, row_spec),
-        item_shards=_shard_rows(V, rows_i, row_spec))
+        item_shards=_shard_rows(V, rows_i, row_spec), **extra)
     record_state(sharded.summary())
     logger.info("factors sharded for serving: %d users + %d items x r=%d "
-                "over %d device(s), %.1f MiB/shard", n_users, n_items,
-                rank, n_dev, sharded.per_shard_bytes() / 2**20)
+                "(%s) over %d device(s), %.1f MiB/shard", n_users, n_items,
+                rank, sharded.dtype, n_dev,
+                sharded.per_shard_bytes() / 2**20)
     return sharded
 
 
@@ -350,12 +475,14 @@ def sharded_program_specs(sharded: ShardedFactors, buckets: Iterable[int],
     from predictionio_tpu.serving.aot import ProgramSpec
 
     out: List[Any] = []
+    name = ("topk_for_users_sharded_quant" if sharded.dtype == "int8"
+            else "topk_for_users_sharded")
     all_buckets = sorted({1, *(int(b) for b in buckets)})
     for b in all_buckets:
         for k in ks:
             out.append(ProgramSpec(
-                name="topk_for_users_sharded",
-                key=("topk_for_users_sharded", sharded.n_users,
+                name=name,
+                key=(name, sharded.n_users,
                      sharded.n_items, sharded.rank, sharded.n_shards,
                      int(b), int(k)),
                 lower=_sharded_lowerer(sharded, int(b), int(k)),
@@ -367,8 +494,28 @@ def _sharded_lowerer(sharded: ShardedFactors, bucket: int, k: int):
     def lower():
         axis = sharded.mesh.axis_names[0]
         row = NamedSharding(sharded.mesh, P(axis, None))
+        vec = NamedSharding(sharded.mesh, P(axis))
         rep = NamedSharding(sharded.mesh, P())
         n_dev = sharded.n_shards
+        statics = dict(k=k, n_items=sharded.n_items,
+                       rows_dev_u=sharded.rows_dev_u,
+                       rows_dev_i=sharded.rows_dev_i, mesh=sharded.mesh)
+        ixs = jax.ShapeDtypeStruct((bucket,), np.int32, sharding=rep)
+        if sharded.dtype == "int8":
+            return topk_for_users_sharded_quant.lower(
+                jax.ShapeDtypeStruct(
+                    (sharded.rows_dev_u * n_dev, sharded.rank),
+                    np.int8, sharding=row),
+                jax.ShapeDtypeStruct(
+                    (sharded.rows_dev_u * n_dev,), np.float32,
+                    sharding=vec),
+                jax.ShapeDtypeStruct(
+                    (sharded.rows_dev_i * n_dev, sharded.rank),
+                    np.int8, sharding=row),
+                jax.ShapeDtypeStruct(
+                    (sharded.rows_dev_i * n_dev,), np.float32,
+                    sharding=vec),
+                ixs, **statics)
         return topk_for_users_sharded.lower(
             jax.ShapeDtypeStruct(
                 (sharded.rows_dev_u * n_dev, sharded.rank),
@@ -376,10 +523,7 @@ def _sharded_lowerer(sharded: ShardedFactors, bucket: int, k: int):
             jax.ShapeDtypeStruct(
                 (sharded.rows_dev_i * n_dev, sharded.rank),
                 np.float32, sharding=row),
-            jax.ShapeDtypeStruct((bucket,), np.int32, sharding=rep),
-            k=k, n_items=sharded.n_items,
-            rows_dev_u=sharded.rows_dev_u,
-            rows_dev_i=sharded.rows_dev_i, mesh=sharded.mesh)
+            ixs, **statics)
     return lower
 
 
@@ -405,6 +549,13 @@ def _register() -> None:
              "prepare_serving chose the sharded layout; mesh-topology-"
              "specific, so the train-time declared export skips it and "
              "the deploy-side prebuild owns it")
+    aot.register_jit(
+        "topk_for_users_sharded_quant", topk_for_users_sharded_quant,
+        kind="serving",
+        note="enumerated per (bucket, k) by sharded_program_specs when "
+             "the sharded layout carries int8 factors (ops/quant.py); "
+             "mesh-topology-specific like its fp32 sibling, deploy-side "
+             "prebuild owns it")
 
 
 _register()

@@ -85,6 +85,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # BENCH_STRICT_EXTRAS=1, trended here
     ("serve_sharded_p99_ms", "down", False),
     ("serve_sharded_overhead_pct", "down", False),
+    # quantized-serving era (ops/quant.py + ops/topk_pallas.py): the
+    # int8(+fused) path's p99, its factor-matrix HBM ratio vs fp32, and
+    # the wire-level recall@k — the strict gates (p99 <= fp32, ratio <=
+    # 0.30, recall >= 0.99) live in the bench's serve-quant leg under
+    # BENCH_STRICT_EXTRAS=1; trended here so drift is visible round
+    # over round
+    ("serve_quant_p99_ms", "down", False),
+    ("serve_quant_hbm_ratio", "down", False),
+    ("serve_quant_recall", "up", False),
     # static-analysis era (tools/analyze): `pio lint` runs inside the
     # bench's strict leg; findings are gated at 0 absolutely below,
     # suppressed counts are trended so baseline debt is visible per

@@ -216,6 +216,7 @@ def cmd_deploy(args) -> int:
         slo_availability=args.slo_availability,
         slo_latency_ms=args.slo_latency_ms,
         shard_serving=args.shard_serving,
+        serve_quant=args.serve_quant,
     )
     if args.compile_cache:
         os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
@@ -660,6 +661,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "to total/n_dev; auto = multi-device "
                          "accelerator meshes only; PIO_SERVE_SHARD "
                          "overrides)")
+    sp.add_argument("--serve-quant", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="serve top-k from int8 factor matrices with "
+                         "per-row fp32 scales (ops/quant.py; ~4x less "
+                         "HBM footprint and bandwidth, ranking-parity "
+                         "contract recall@k >= 0.99 — KNOWN_ISSUES #12; "
+                         "auto = accelerator backends only, gated by "
+                         "the deploy-time recall probe; composes with "
+                         "--shard-serving; PIO_SERVE_QUANT overrides)")
     sp.add_argument("--slo-availability", type=float, default=None,
                     help="availability SLO target, e.g. 0.999 "
                          "(default PIO_SLO_AVAILABILITY or 0.999)")

@@ -20,6 +20,9 @@ waterfall's slowest sampled request:
                         hit — in 0.3 s), ready in 0.4 s
       sharding    ok    8 shard(s), all_gather merge, 2.1 MiB
                         factors/shard, min per-device HBM headroom 84%
+      quant       ok    int8 factors + per-row scales: 3.7 MiB vs
+                        13.2 MiB fp32 (0.28x), fused Pallas kernel,
+                        last recall gate 0.9975
       hbm         --    no device memory stats (CPU / unsupported)
       traces      ok    512 spans buffered
     VERDICT: OK
@@ -61,6 +64,13 @@ _SAMPLE_RE = re.compile(
 #: most recent trace id per bucket): stripped before sample parsing so
 #: an exemplar-bearing line still yields its (name, labels, value)
 _EXEMPLAR_RE = re.compile(r'\s+#\s+\{.*$')
+
+
+def _fmt_bytes(n: float) -> str:
+    """MiB for real models, KiB below 1 MiB — a 1.5 KB toy model must
+    not render as '0.0 MiB'."""
+    return (f"{n / 2**20:.1f} MiB" if n >= 2**20
+            else f"{n / 2**10:.1f} KiB")
 
 
 def parse_metrics(text: str) -> Dict[str, List[Tuple[str, float]]]:
@@ -395,6 +405,40 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
             state = OK
         checks.append(("sharding", state, detail))
 
+    # quantized serving (ops/quant.py) ---------------------------------
+    quant_info = device.get("quant") or {}
+    quant_mode = metric_max(samples, "pio_serve_quant_mode")
+    if not quant_info and not (quant_mode or 0):
+        checks.append(("quant", NA,
+                       _OPT_IN.format("the quantized-serving state")
+                       if telemetry_off
+                       else "fp32 factors (quantized serving off)"))
+    elif quant_info.get("fellBack"):
+        checks.append(("quant", WARN,
+                       "quantized serving REQUESTED but fell back to "
+                       "fp32 (recall probe below the floor, or the int8 "
+                       "layout failed — see the deploy log); serving "
+                       "costs 4x the HBM the operator asked for"))
+    else:
+        i8 = quant_info.get("int8Bytes") or 0
+        f32 = quant_info.get("fp32Bytes") or 0
+        detail = "int8 factors + per-row scales"
+        if i8 and f32:
+            detail += (f": {_fmt_bytes(i8)} vs {_fmt_bytes(f32)} "
+                       f"fp32 ({i8 / f32:.2f}x)")
+        if quant_info.get("sharded"):
+            detail += f", sharded over {quant_info.get('shards', '?')}"
+        elif quant_info.get("fused"):
+            detail += (", fused Pallas kernel"
+                       + (" (interpret)" if quant_info.get("interpret")
+                          else ""))
+        recall = quant_info.get("recall")
+        if recall is None:
+            recall = metric_max(samples, "pio_serve_quant_recall")
+        if recall is not None:
+            detail += f", last recall gate {recall:.4f}"
+        checks.append(("quant", OK, detail))
+
     # HBM headroom -----------------------------------------------------
     in_use = metric_sum(samples, "pio_hbm_bytes_in_use")
     limit = metric_sum(samples, "pio_hbm_bytes_limit")
@@ -411,9 +455,17 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
         frac = in_use / limit
         state = RED if frac >= _HBM_RED else (
             WARN if frac >= _HBM_WARN else OK)
-        checks.append(("hbm", state,
-                       f"{in_use / 2**30:.2f} / {limit / 2**30:.2f} GiB "
-                       f"in use ({frac * 100:.0f}%)"))
+        detail = (f"{in_use / 2**30:.2f} / {limit / 2**30:.2f} GiB "
+                  f"in use ({frac * 100:.0f}%)")
+        # the headroom shown already reflects the quantized footprint
+        # (memory_stats measures what is actually resident); say how
+        # much of it quantization is saving so the number reads right
+        i8 = quant_info.get("int8Bytes") or 0
+        f32 = quant_info.get("fp32Bytes") or 0
+        if not quant_info.get("fellBack") and i8 and f32 > i8:
+            detail += (f" — int8 factors save "
+                       f"{(f32 - i8) / 2**20:.1f} MiB vs fp32")
+        checks.append(("hbm", state, detail))
 
     # traces -----------------------------------------------------------
     tr = _json_body(scraped["traces"])

@@ -110,6 +110,14 @@ class ServerConfig:
     #: on /reload hot-swap; "off" keeps the replicated path.
     #: PIO_SERVE_SHARD overrides.
     shard_serving: str = "auto"
+    #: quantized serving (ops/quant.py): "on" serves top-k from int8
+    #: factor matrices with per-row fp32 scales (~4x less HBM footprint
+    #: and bandwidth; ranking-parity contract, KNOWN_ISSUES #12);
+    #: "auto" quantizes only on a real accelerator backend AND when the
+    #: deploy-time recall probe clears the floor; "off" keeps today's
+    #: bit-compatible fp32 path. Composes with shard_serving (int8
+    #: shards). PIO_SERVE_QUANT overrides.
+    serve_quant: str = "auto"
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -232,6 +240,7 @@ class QueryAPI:
         self.time_to_ready_s: Optional[float] = None
         self._aot_state: Optional[Dict[str, Any]] = None
         self._shard_state: Optional[Dict[str, Any]] = None
+        self._quant_state: Optional[Dict[str, Any]] = None
         reg = telemetry.registry()
         self._m_time_to_ready = reg.gauge(
             "pio_time_to_ready_seconds",
@@ -274,21 +283,31 @@ class QueryAPI:
         models = prepare_deploy(
             self.ctx, engine, engine_params, instance.id, models,
             algorithms=algorithms)
-        # shard-serving scope (parallel/serve_dist.py): each algorithm's
-        # prepare_serving resolves the deploy's mode inside it. A reload
-        # is flagged so "auto" falls back to the replicated layout
-        # during hot-swap (the swap window holds BOTH models; "on"
-        # stays sharded — the operator's explicit call).
+        # shard-serving + serve-quant scopes (parallel/serve_dist.py,
+        # ops/quant.py): each algorithm's prepare_serving resolves the
+        # deploy's modes inside them. A reload is flagged so sharding's
+        # "auto" falls back to the replicated layout during hot-swap
+        # (the swap window holds BOTH models; "on" stays sharded — the
+        # operator's explicit call); quantization re-runs on every
+        # load, reload included — re-quantizing IS the hot-swap
+        # contract for the int8 path.
+        from predictionio_tpu.ops import quant as serve_quant
         from predictionio_tpu.parallel import serve_dist
         is_reload = getattr(self, "engine_instance", None) is not None
         with serve_dist.deploy_scope(self.config.shard_serving,
-                                     reload=is_reload):
+                                     reload=is_reload), \
+                serve_quant.deploy_scope(self.config.serve_quant,
+                                         reload=is_reload):
             models = [a.prepare_serving(m)
                       for a, m in zip(algorithms, models)]
+            quant_requested = serve_quant.serving_enabled()
         shard_state = next(
             (m.sharding.summary() for m in models
              if getattr(m, "sharding", None) is not None), None)
         serve_dist.record_state(shard_state)
+        quant_state = serve_quant.summarize_deploy(
+            models, requested=quant_requested)
+        serve_quant.record_state(quant_state)
         aot_state, serve_buckets = self._prebuild_aot(
             instance, algorithms, models)
         batcher = self._make_batcher(algorithms, models, serving,
@@ -302,6 +321,7 @@ class QueryAPI:
             self.serving = serving
             self._aot_state = aot_state
             self._shard_state = shard_state
+            self._quant_state = quant_state
             old_batcher, self._batcher = self._batcher, batcher
         if old_batcher is not None:   # reload: drain in-flight, then retire
             old_batcher.close()
@@ -531,6 +551,11 @@ class QueryAPI:
             # only when sharded serving is live: replicated deploys keep
             # the exact legacy key set (wire parity)
             out["sharding"] = {"enabled": True, **self._shard_state}
+        if getattr(self, "_quant_state", None) is not None:
+            # only when quantized serving is live OR was requested and
+            # fell back (the operator must be able to see the fallback);
+            # fp32 deploys keep the exact legacy key set (wire parity)
+            out["quant"] = self._quant_state
         return out
 
     def _readyz(self) -> Response:

@@ -88,6 +88,27 @@ def non_finite_report(obj: Any, limit: int = 8) -> List[str]:
     return bad
 
 
+def factor_bytes_by_dtype(obj: Any) -> dict:
+    """Array bytes in a model tree, summed per dtype name — the storage
+    / serving-footprint accounting the quantized-serving surfaces
+    (ops/quant.py summary, the bench's HBM-ratio leg) report. Walks the
+    same structure serialization walks, so quantized int8 blocks and
+    their fp32 scale vectors (which ride the pickle container like any
+    other dataclass leaves) are each counted under their own dtype."""
+    out: dict = {}
+
+    def count(x):
+        key = str(x.dtype)
+        out[key] = out.get(key, 0) + int(x.nbytes)
+        return x
+
+    _map_arrays(
+        to_host(obj),
+        lambda x: isinstance(x, np.ndarray) and x.dtype != object,
+        count)
+    return out
+
+
 def serialize_models(models: List[Any], check_finite: bool = False) -> bytes:
     host = to_host(models)
     if check_finite:

@@ -294,6 +294,35 @@ def test_aot_pass_scope_is_structural_not_a_list():
     assert [f.detail for f in found] == ["forgotten"]
 
 
+def test_aot_pass_fires_on_unregistered_quant_kernel():
+    """ISSUE 11 seeded defect: a quantized serving module that registers
+    one kernel but forgets its fused sibling — the forgotten one would
+    compile lazily on the first quantized request, exactly the cliff
+    the AOT pass exists to catch."""
+    src = ("import jax\n"
+           "from predictionio_tpu.serving.aot import register_jit\n"
+           "@jax.jit\n"
+           "def topk_quant(x):\n"
+           "    return x\n"
+           "@jax.jit\n"
+           "def topk_quant_fused(x):\n"
+           "    return x\n"
+           "register_jit('topk_quant', topk_quant)\n")
+    found = aot_registration.run(
+        [_mod(src, rel="predictionio_tpu/ops/quant_v2.py")])
+    assert _rules(found) == ["aot-unregistered-jit"]
+    assert [f.detail for f in found] == ["topk_quant_fused"]
+
+
+def test_aot_scope_covers_quant_modules_automatically():
+    """ops/quant.py and ops/topk_pallas.py enter the AOT lint scope via
+    register_jit reachability — no hand-maintained list was touched."""
+    modules = walker.discover(ROOT)
+    scope = {m.rel for m in aot_registration.serving_scope(modules)}
+    assert "predictionio_tpu/ops/quant.py" in scope
+    assert "predictionio_tpu/ops/topk_pallas.py" in scope
+
+
 def test_debug_surface_pass_fires_on_private_path():
     telemetry_src = "DEBUG_PATHS = ('/debug/slow.json',)\n"
     offender = "PATH = '/debug/private.json'\n"
