@@ -1009,6 +1009,122 @@ def measure_waterfall(storage, engine, n_conns: int = 8,
     }
 
 
+def measure_journal(storage, engine, n_conns: int = 8,
+                    queries_per_client: int = 100):
+    """Flight-recorder leg (common/journal.py): the same batched serving
+    path with PIO_JOURNAL off vs on (telemetry ON in both legs), then a
+    /debug/events.json read whose event counts land in the JSON detail.
+
+    The journal's cost model is "operational events are rare, requests
+    never emit" — so journal-on p99 must sit within 5% of journal-off
+    (absolute floor 0.2 ms, like the telemetry/waterfall legs). The on
+    leg must also actually RECORD something: the deploy's lifecycle
+    event (model generation live) proves the emitters are wired.
+    Hard-fails under BENCH_STRICT_EXTRAS=1."""
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.common import journal
+    from predictionio_tpu.common import telemetry as _telemetry
+    from predictionio_tpu.common import tracing
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+    def leg(journal_on: bool):
+        _telemetry.set_enabled(True)
+        journal.set_enabled(journal_on)
+        try:
+            api = QueryAPI(storage=storage, engine=engine,
+                           config=ServerConfig(batching="on"))
+            server = make_server(api, "127.0.0.1", 0)
+            port = server.server_address[1]
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            lat_lock = threading.Lock()
+            lat: list = []
+            errors: list = []
+            barrier = threading.Barrier(n_conns + 1)
+
+            def client(cx):
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    my = []
+                    barrier.wait()
+                    for q in range(queries_per_client):
+                        body = json.dumps(
+                            {"user": f"u{(cx * 131 + q * 17) % 1000}",
+                             "num": 10})
+                        t0 = time.perf_counter()
+                        conn.request(
+                            "POST", "/queries.json", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        my.append(time.perf_counter() - t0)
+                        assert resp.status == 200, payload[:200]
+                    conn.close()
+                    with lat_lock:
+                        lat.extend(my)
+                except Exception as e:
+                    errors.append(e)
+
+            events = None
+            try:
+                threads = [threading.Thread(target=client, args=(cx,))
+                           for cx in range(n_conns)]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.request("GET", "/debug/events.json?limit=16")
+                resp = conn.getresponse()
+                assert resp.status == 200, "events.json read failed"
+                events = json.loads(resp.read().decode("utf-8"))
+                conn.close()
+            finally:
+                server.shutdown()
+                api.close()
+            lat_ms = np.asarray(lat) * 1e3
+            return {"p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                    }, events
+        finally:
+            _telemetry.set_enabled(None)
+            journal.set_enabled(None)
+
+    off, off_events = leg(False)
+    on, on_events = leg(True)
+    if off_events is None or off_events.get("enabled") is not False:
+        raise RuntimeError("journal-off leg still reports an enabled "
+                           f"journal: {off_events}")
+    recorded = (on_events or {}).get("events") or []
+    if not any(e.get("category") == "lifecycle" for e in recorded):
+        raise RuntimeError(
+            "journal-on leg recorded no lifecycle deploy event — the "
+            f"emitters are not wired ({recorded})")
+    overhead_ok = (on["p99_ms"] <= off["p99_ms"] * 1.05
+                   or on["p99_ms"] - off["p99_ms"] <= 0.2)
+    return {
+        "journal_off": off,
+        "journal_on": on,
+        "journal_on_p99_ms": on["p99_ms"],
+        "journal_overhead_p99_pct": round(
+            (on["p99_ms"] / max(off["p99_ms"], 1e-9) - 1.0) * 100, 2),
+        "journal_overhead_ok": bool(overhead_ok),
+        "journal_events_total": int(journal.events_total()),
+        "journal_events_buffered": len(recorded),
+        "trace_tail_retained": int(tracing.tail_retained()),
+    }
+
+
 def measure_serve_sharded(storage, engine, n_conns: int = 8,
                           queries_per_client: int = 100):
     """Sharded-serving leg (parallel/serve_dist.py): the same batched
@@ -1829,6 +1945,17 @@ def main() -> None:
             except Exception as e:
                 wf = {"waterfall_error": f"{type(e).__name__}: {e}"}
 
+        # flight-recorder leg (common/journal.py): journal off vs on
+        # through the same batched path + a /debug/events.json read;
+        # requests never emit, so the on-p99 tax gates at <= 5% under
+        # strict extras and the deploy's lifecycle event must be there
+        jrnl = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                jrnl = measure_journal(storage, engine)
+            except Exception as e:
+                jrnl = {"journal_error": f"{type(e).__name__}: {e}"}
+
         # sharded-serving leg (parallel/serve_dist.py): replicated vs
         # row-sharded p99 through the same batched path, wire-level
         # probe parity, and the HBM-ceiling demonstration; the sharded
@@ -1994,6 +2121,7 @@ def main() -> None:
                 **(throughput or {}),
                 **(telem or {}),
                 **(wf or {}),
+                **(jrnl or {}),
                 **(shard_leg or {}),
                 **(quant_leg or {}),
                 **(recompile_watch or {}),
@@ -2115,6 +2243,18 @@ def main() -> None:
                     f"({wf['waterfall_on']['p99_ms']} ms) exceeds "
                     "sampling-off "
                     f"({wf['waterfall_off']['p99_ms']} ms) by >5% "
+                    "with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and jrnl:
+            if jrnl.get("journal_error"):
+                failures.append(
+                    f"journal leg crashed ({jrnl['journal_error']}) "
+                    "with BENCH_STRICT_EXTRAS=1")
+            elif not jrnl.get("journal_overhead_ok"):
+                failures.append(
+                    "journal-on p99 "
+                    f"({jrnl['journal_on']['p99_ms']} ms) exceeds "
+                    "journal-off "
+                    f"({jrnl['journal_off']['p99_ms']} ms) by >5% "
                     "with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and shard_leg:
             if shard_leg.get("serve_sharded_error"):

@@ -14,6 +14,10 @@ three directions mechanically:
 - a declaration missing from README.md is a knob operators can't
   discover (``env-undocumented`` / ``metric-undocumented``).
 
+``JOURNAL_CATEGORIES`` is the same registry for the operational
+journal (common/journal.py): every ``journal.emit(category=...)`` call
+site must use a category declared there (``journal-undeclared``).
+
 Names ending in ``*`` declare a PREFIX (config families whose full
 names are user-composed, e.g. ``PIO_STORAGE_SOURCES_<NAME>_TYPE``).
 Prefixes are exempt from the dead-declaration check — their concrete
@@ -214,6 +218,21 @@ ENV_VARS: Dict[str, str] = {
         "(propagated X-PIO-Trace headers are always honored)",
     "PIO_TRACE_BUFFER":
         "trace ring-buffer capacity in spans (default 512)",
+    "PIO_TRACE_TAIL_MS":
+        "tail-based trace retention: a span at/over this many ms pins "
+        "its whole trace in the tail ring, surviving main-ring churn "
+        "(default 100; 0 disables slow-pinning — error/journal pins "
+        "stay)",
+    "PIO_TRACE_TAIL_TRACES":
+        "tail-ring capacity in whole pinned traces (default 64, oldest "
+        "pin evicted first)",
+    "PIO_JOURNAL":
+        "0 disables the operational-event journal (flight recorder; "
+        "default on — /debug/events.json then answers enabled:false "
+        "with no events)",
+    "PIO_JOURNAL_BUFFER":
+        "journal ring capacity in events (default 1024; seq numbers "
+        "stay monotonic across eviction)",
     "PIO_WATERFALL":
         "1 samples per-request latency waterfalls into "
         "pio_serve_stage_seconds + /debug/slow.json (default 0)",
@@ -315,12 +334,53 @@ METRICS: Dict[str, str] = {
         "persistent compile-cache entry count (collector)",
     "pio_compile_cache_bytes":
         "persistent compile-cache size in bytes (collector)",
+    # ----------------------------------------------------- flight recorder
+    "pio_journal_events_total":
+        "operational journal events by category and level (the events "
+        "themselves ride /debug/events.json)",
     # ---------------------------------------------------------------- SLO
     "pio_slo_target": "configured SLO objective (collector)",
     "pio_slo_error_budget_remaining":
         "error budget left, 1 = untouched (collector)",
     "pio_slo_burn_rate":
         "error rate / allowed rate over fast+slow windows (collector)",
+}
+
+
+#: every journal category (common/journal.py ``emit(category=...)``) ->
+#: one-line meaning. The ``declarations`` lint pass requires every emit
+#: call site to use a category declared here — a typo'd category is a
+#: timeline nobody's filter ever finds.
+JOURNAL_CATEGORIES: Dict[str, str] = {
+    "breaker":
+        "circuit-breaker transitions: open (red) / half-open (warn) / "
+        "closed (info), per endpoint (common/resilience.py)",
+    "retry":
+        "a retry schedule exhausted its attempts and surfaced the "
+        "failure to the caller (resilience.RetryPolicy, remote driver)",
+    "degraded":
+        "a serving-path side-channel lookup failed soft; the response "
+        "was served from fallbacks and flagged degraded",
+    "wal":
+        "event-log durability events: torn-tail repairs after a crash, "
+        "group-commit stalls (data/storage/eventlog.py)",
+    "lifecycle":
+        "daemon lifecycle: model load + /reload hot-swap with a "
+        "generation id, drain begin/end, failed reloads "
+        "(workflow/create_server.py)",
+    "quant":
+        "quantized serving fell back to fp32: recall-probe refusal or "
+        "a failed int8 layout (ops/quant.py)",
+    "aot":
+        "an AOT serving-program prebuild failed; that program compiles "
+        "lazily on the latency path (serving/aot.py)",
+    "recompile":
+        "post-warmup XLA recompile on the serving path — the "
+        "padding-bucket alarm (common/devicewatch.py)",
+    "slo":
+        "SLO burn-rate threshold crossings: fast-window page edges "
+        "(red), slow-window ticket edges (warn), and recoveries "
+        "(common/slo.py)",
 }
 
 

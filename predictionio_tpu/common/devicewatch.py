@@ -254,6 +254,13 @@ def _note_post_warmup(fn: str, signature: str,
         "signature=%s duration=%s — a padding bucket or static shape "
         "stopped holding", fn, signature or "?",
         f"{duration_s:.3f}s" if duration_s is not None else "n/a")
+    from predictionio_tpu.common import journal
+    journal.emit(
+        "recompile",
+        f"post-warmup XLA recompile on the serving path: {fn} "
+        f"[{signature or '?'}]",
+        level=journal.RED, fn=fn, signature=signature or "?",
+        durationS=event["durationS"])
 
 
 def _on_compile_duration(event: str, duration: float, **_kw: Any) -> None:

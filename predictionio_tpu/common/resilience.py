@@ -57,14 +57,37 @@ logger = logging.getLogger("predictionio_tpu.resilience")
 
 def _note_breaker_transition(endpoint: str, to_state: str) -> None:
     """Mirror a breaker state change into the metrics registry (gated on
-    PIO_TELEMETRY; local import keeps this module usable standalone)."""
-    from predictionio_tpu.common import telemetry
+    PIO_TELEMETRY; local import keeps this module usable standalone) and
+    the operational journal (always — an opened breaker is exactly the
+    history the flight recorder exists for)."""
+    from predictionio_tpu.common import journal, telemetry
+    journal.emit(
+        "breaker",
+        f"circuit breaker {to_state} for {endpoint or '?'}",
+        level=(journal.RED if to_state == "open" else
+               journal.WARN if to_state == "half-open" else journal.INFO),
+        endpoint=endpoint or "?", to=to_state)
     if telemetry.on():
         telemetry.registry().counter(
             "pio_breaker_transitions_total",
             "Circuit-breaker state transitions by endpoint",
             labelnames=("endpoint", "to")).labels(
                 endpoint=endpoint or "?", to=to_state).inc()
+
+
+def note_retries_exhausted(where: str, attempts: int,
+                           error: BaseException) -> None:
+    """Journal a retry schedule giving up (the caller re-raises): the
+    moment a transient blip became a caller-visible failure. Called by
+    :meth:`RetryPolicy.call` and the remote driver's transport loop."""
+    from predictionio_tpu.common import journal
+    journal.emit(
+        "retry",
+        f"retries exhausted for {where or '?'} after {attempts} "
+        f"attempt(s): {type(error).__name__}",
+        level=journal.WARN,
+        where=where or "?", attempts=int(attempts),
+        error=f"{type(error).__name__}: {error}")
 
 
 def _env_float(name: str, default: Optional[float]) -> Optional[float]:
@@ -178,8 +201,14 @@ class RetryPolicy:
         while True:
             try:
                 return fn()
-            except retry_on:
+            except retry_on as e:
                 if not self.may_retry(attempt, deadline, clock):
+                    if attempt > 0:   # a retried operation gave up —
+                        # journal it; a no-retry policy failing first
+                        # try is the caller's ordinary error path
+                        note_retries_exhausted(
+                            getattr(fn, "__name__", "?") or "?",
+                            attempt + 1, e)
                     raise
                 sleep(self.backoff_s(attempt))
                 attempt += 1
@@ -566,6 +595,11 @@ def note_degraded(reason: str) -> None:
     with _degraded_lock:
         _degraded_total += 1
     logger.warning("degraded: %s", reason)
+    # the degraded flip is journal history (and pins the active trace,
+    # so the tainted request's spans stay resolvable)
+    from predictionio_tpu.common import journal
+    journal.emit("degraded", f"degraded serving: {reason}",
+                 level=journal.WARN, reason=reason)
 
 
 def pop_degraded() -> Tuple[str, ...]:

@@ -153,6 +153,9 @@ class SLOEngine:
             "availability": deque(maxlen=4096),
             "latency": deque(maxlen=4096),
         }
+        #: (slo, window) -> currently over its burn threshold; edge
+        #: transitions (not levels) land in the operational journal
+        self._hot: Dict[Tuple[str, str], bool] = {}
 
     # -------------------------------------------------------------- windows
     def _window_rate(self, history: deque, now: float, good: float,
@@ -214,7 +217,41 @@ class SLOEngine:
                     "burn_fast": fast,
                     "burn_slow": slow,
                 }
+        self._note_crossings(out)
         return out
+
+    def _note_crossings(self, verdict: Dict[str, Any]) -> None:
+        """Journal burn-rate THRESHOLD CROSSINGS (SRE Workbook tiers:
+        fast >= 14.4x pages -> red, slow >= 6x tickets -> warn) — edges
+        only, so a sustained burn is one event, not one per scrape, and
+        the recovery is recorded too. Runs outside the snapshot lock
+        (the journal takes its own)."""
+        from predictionio_tpu.common import journal
+        tiers = (("fast", FAST_BURN_RED, journal.RED),
+                 ("slow", SLOW_BURN_WARN, journal.WARN))
+        for slo, v in verdict.items():
+            for window, threshold, level in tiers:
+                burn = v["burn_" + window]
+                hot = burn >= threshold
+                key = (slo, window)
+                was = self._hot.get(key, False)
+                if hot == was:
+                    continue
+                self._hot[key] = hot
+                if hot:
+                    journal.emit(
+                        "slo",
+                        f"{slo} burn rate {burn:.1f}x over the {window} "
+                        f"window (threshold {threshold:g}x)",
+                        level=level, slo=slo, window=window,
+                        burn=round(burn, 2), threshold=threshold)
+                else:
+                    journal.emit(
+                        "slo",
+                        f"{slo} {window}-window burn subsided "
+                        f"({burn:.1f}x, below {threshold:g}x)",
+                        level=journal.INFO, slo=slo, window=window,
+                        burn=round(burn, 2), threshold=threshold)
 
     # ------------------------------------------------------------ collector
     def collect(self) -> Iterable[str]:

@@ -533,16 +533,18 @@ _TRACES_LIMIT_MAX = 1024
 #: is automatically everywhere, and one added anywhere else fails the
 #: lint until it is shared.
 DEBUG_PATHS: Tuple[str, ...] = (
-    "/debug/device.json", "/debug/slow.json", "/debug/profile")
+    "/debug/device.json", "/debug/slow.json", "/debug/profile",
+    "/debug/events.json")
 
 
 def handle_route(method: str, path: str,
                  query: Optional[Dict[str, str]] = None,
                  accept: Optional[str] = None):
     """Serve ``GET /metrics`` / ``GET /traces.json`` / the ``/debug/*``
-    surfaces (``device.json``, ``slow.json``, ``profile``) for any
-    daemon's route handler; returns None when the request is not a
-    telemetry route (the handler continues with its own table).
+    surfaces (``device.json``, ``slow.json``, ``profile``,
+    ``events.json``) for any daemon's route handler; returns None when
+    the request is not a telemetry route (the handler continues with
+    its own table).
     The read surfaces are unauthenticated by design, like ``/healthz``
     — the payload is operational counters, not data; the one write
     surface (``POST /debug/profile``) confines its effects to the
@@ -569,6 +571,38 @@ def handle_route(method: str, path: str,
         return 200, REGISTRY.exposition(openmetrics=om), {
             "Content-Type": (OPENMETRICS_CONTENT_TYPE if om
                              else EXPOSITION_CONTENT_TYPE)}
+    if path == "/debug/events.json":
+        # the operational journal (common/journal.py): an incremental
+        # tail read — since_seq is the cursor, level is a MINIMUM
+        # severity, category narrows to one subsystem
+        from predictionio_tpu.common import journal
+        since_seq = 0
+        category = None
+        level = None
+        limit = 256
+        if query:
+            raw = query.get("since_seq")
+            if raw:
+                try:
+                    since_seq = int(raw)
+                except ValueError:
+                    return 400, {"message": "since_seq must be an "
+                                 f"integer, got {raw!r}"}
+            raw = query.get("limit")
+            if raw:
+                try:
+                    limit = max(1, min(int(raw), _TRACES_LIMIT_MAX))
+                except ValueError:
+                    return 400, {"message": "limit must be an integer, "
+                                 f"got {raw!r}"}
+            level = query.get("level") or None
+            if level is not None and level not in journal._SEVERITY:
+                return 400, {"message": "level must be one of "
+                             f"info/warn/red, got {level!r}"}
+            category = query.get("category") or None
+        return 200, journal.snapshot(since_seq=since_seq,
+                                     category=category, level=level,
+                                     limit=limit)
     if path == "/debug/slow.json":
         from predictionio_tpu.common import waterfall
         limit = _TRACES_LIMIT_DEFAULT

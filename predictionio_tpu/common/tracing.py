@@ -16,6 +16,17 @@ Spans land in a bounded process-wide ring buffer (``PIO_TRACE_BUFFER``,
 default 512 spans — old spans fall off; this is a flight recorder, not a
 TSDB) served by ``GET /traces.json`` on every daemon.
 
+Tail-based retention (Canopy's insight, SOSP '17: keep the traces worth
+debugging, not a uniform sample): a SECOND bounded ring pins whole
+traces that (a) contain a span at or over ``PIO_TRACE_TAIL_MS``
+(default 100 ms), (b) were flagged by an error/degraded response, or
+(c) are referenced by an operational-journal event
+(``common/journal.py``). Pinned traces survive main-ring churn —
+``/debug/slow.json`` entries, /metrics exemplars, and journal records
+keep resolving through ``/traces.json?trace_id=`` long after healthy
+traffic evicted their spans. Capacity: ``PIO_TRACE_TAIL_TRACES`` whole
+traces (default 64), oldest pin evicted first.
+
 Clocking: span durations are ``time.perf_counter`` deltas; the absolute
 timestamp is taken once per span from the wall clock for display only.
 Any span that times device work must end in a real host transfer
@@ -32,7 +43,7 @@ import os
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -113,13 +124,126 @@ def _buffer_cap() -> int:
         return 512
 
 
+def _tail_ms() -> float:
+    """Span duration at/over which a trace is pinned in the tail ring
+    (``PIO_TRACE_TAIL_MS``, default 100 ms; 0 disables slow-pinning —
+    error/journal pins still work)."""
+    raw = os.environ.get("PIO_TRACE_TAIL_MS", "")
+    try:
+        return float(raw) if raw else 100.0
+    except ValueError:
+        return 100.0
+
+
+def _tail_cap() -> int:
+    raw = os.environ.get("PIO_TRACE_TAIL_TRACES", "")
+    try:
+        return max(4, int(raw)) if raw else 64
+    except ValueError:
+        return 64
+
+
+class _TailRing:
+    """Whole-trace retention: trace_id -> {reasons, spans} pinned until
+    ``PIO_TRACE_TAIL_TRACES`` newer pins push it out. Pinning copies the
+    trace's spans already in the main ring; spans recorded AFTER the pin
+    are appended as they arrive (one dict lookup per span — the whole
+    added cost on the span-record path)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: trace_id -> {"reasons": [str], "spans": {span_id: Span}}
+        self._traces: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    def pin(self, trace_id: str, reason: str,
+            existing: List[Span]) -> None:
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                entry = {"reasons": [], "spans": {}}
+                self._traces[trace_id] = entry
+            if reason not in entry["reasons"]:
+                entry["reasons"].append(reason)
+            for s in existing:
+                if s.trace_id == trace_id:
+                    entry["spans"][s.span_id] = s
+            cap = _tail_cap()
+            while len(self._traces) > cap:
+                self._traces.popitem(last=False)   # oldest pin goes first
+
+    def offer(self, span: Span) -> bool:
+        """Append ``span`` if its trace is pinned; False otherwise."""
+        with self._lock:
+            entry = self._traces.get(span.trace_id)
+            if entry is None:
+                return False
+            entry["spans"][span.span_id] = span
+            return True
+
+    def spans_for(self, trace_id: str) -> List[Span]:
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            return list(entry["spans"].values()) if entry else []
+
+    def reasons_for(self, trace_id: str) -> List[str]:
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            return list(entry["reasons"]) if entry else []
+
+    def retained(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
 _ring = _Ring(_buffer_cap())
+_tail = _TailRing()
 _tls = threading.local()
 
 
 def clear() -> None:
-    """Drop every recorded span (tests)."""
+    """Drop every recorded span AND every tail-pinned trace (tests)."""
     _ring.clear()
+    _tail.clear()
+
+
+def pin_trace(trace_id: Optional[str], reason: str) -> None:
+    """Retain ``trace_id``'s spans in the tail ring: its current main-
+    ring spans are copied now and later spans accrue as recorded, so
+    the id keeps resolving via ``/traces.json?trace_id=`` after churn.
+    Callers: the journal (an event referenced the trace), the transport
+    (a 5xx response), the query server (a degraded response), and the
+    slow-span check below. None/empty ids are ignored."""
+    if not trace_id:
+        return
+    _tail.pin(trace_id, reason, _ring.spans())
+
+
+def pin_current(reason: str) -> None:
+    """Pin the calling thread's active trace, if any."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        pin_trace(ctx.trace_id, reason)
+
+
+def tail_retained() -> int:
+    """Traces currently pinned in the tail ring (bench detail)."""
+    return _tail.retained()
+
+
+def _record(span: Span) -> None:
+    """Every recorded span lands here: main ring always; tail ring when
+    its trace is pinned; a span at/over the tail threshold pins its
+    trace (the Canopy tail-sampling decision, made at span end when the
+    latency is known)."""
+    _ring.add(span)
+    if not _tail.offer(span):
+        threshold = _tail_ms()
+        if threshold > 0 and span.duration_s * 1e3 >= threshold:
+            _tail.pin(span.trace_id, "slow", _ring.spans())
 
 
 # ---------------------------------------------------------------------------
@@ -206,7 +330,7 @@ def span(name: str, service: str = ""):
     finally:
         dt = time.perf_counter() - t0
         _tls.ctx = prev
-        _ring.add(Span(
+        _record(Span(
             trace_id=child.trace_id, span_id=child.span_id,
             parent_id=prev.span_id, name=name, service=service,
             start_ts=wall, duration_s=dt))
@@ -219,7 +343,7 @@ def record_span(name: str, ctx: Optional[TraceContext],
     admission wait). No-op when ctx is None."""
     if ctx is None:
         return
-    _ring.add(Span(
+    _record(Span(
         trace_id=ctx.trace_id, span_id=_new_id(), parent_id=ctx.span_id,
         name=name, service=service,
         start_ts=_wall_now() - duration_s, duration_s=duration_s))
@@ -235,25 +359,41 @@ def snapshot(limit: int = 64, trace_id: Optional[str] = None
 
     ``limit`` caps how many traces are grouped and serialized (the ring
     itself stays bounded by PIO_TRACE_BUFFER); ``trace_id`` narrows the
-    result to one trace — the cheap targeted read `pio doctor` and
-    dashboards use instead of dumping the whole buffer. ``spanCount``
-    always reports the ring total so a filtered read still shows how
-    much is buffered."""
+    result to one trace — the cheap targeted read `pio doctor`,
+    dashboards and `pio trace` fleet assembly use instead of dumping
+    the whole buffer. A targeted read also consults the TAIL ring, so
+    a pinned (slow/error/journal-referenced) trace resolves after the
+    main ring churned past it; its pin reasons ride along as
+    ``pinned``. ``spanCount`` always reports the main-ring total so a
+    filtered read still shows how much is buffered."""
     limit = max(1, int(limit))
     spans = _ring.spans()
     by_trace: Dict[str, List[Span]] = {}
     order: List[str] = []
-    for s in spans:
-        if trace_id is not None and s.trace_id != trace_id:
-            continue
+
+    def _add(s: Span) -> None:
         if s.trace_id not in by_trace:
             by_trace[s.trace_id] = []
             order.append(s.trace_id)
         by_trace[s.trace_id].append(s)
+
+    seen_ids = set()
+    for s in spans:
+        if trace_id is not None and s.trace_id != trace_id:
+            continue
+        seen_ids.add(s.span_id)
+        _add(s)
+    pinned_reasons: List[str] = []
+    if trace_id is not None:
+        # tail-ring merge: spans the main ring already evicted
+        for s in _tail.spans_for(trace_id):
+            if s.span_id not in seen_ids:
+                _add(s)
+        pinned_reasons = _tail.reasons_for(trace_id)
     traces = []
     for tid in reversed(order[-limit:]):
         ss = sorted(by_trace[tid], key=lambda s: s.start_ts)
-        traces.append({
+        entry = {
             "traceId": tid,
             "spans": [{
                 "spanId": s.span_id,
@@ -263,6 +403,12 @@ def snapshot(limit: int = 64, trace_id: Optional[str] = None
                 "startMs": round(s.start_ts * 1e3, 3),
                 "durationMs": round(s.duration_s * 1e3, 3),
             } for s in ss],
-        })
+        }
+        if pinned_reasons and tid == trace_id:
+            entry["pinned"] = pinned_reasons
+        traces.append(entry)
     return {"originate": enabled(), "capacity": _ring.capacity,
-            "spanCount": len(spans), "traces": traces}
+            "spanCount": len(spans),
+            "tail": {"capacity": _tail_cap(), "retained": _tail.retained(),
+                     "thresholdMs": _tail_ms()},
+            "traces": traces}

@@ -156,6 +156,10 @@ def dispatch_request(api, method: str, target: str, body: bytes,
             status, payload = response
     except Exception as e:  # handler without its own guard
         status, payload = 500, {"message": str(e)}
+    if status >= 500 and ctx is not None:
+        # an errored traced request is exactly a trace worth keeping:
+        # pin it in the tail ring so its id resolves after churn
+        tracing.pin_trace(ctx.trace_id, "error")
     if t0 is not None:
         telemetry.registry().histogram(
             "pio_http_request_seconds",

@@ -78,6 +78,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from predictionio_tpu.common import journal
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import (
@@ -88,6 +89,11 @@ logger = logging.getLogger(__name__)
 
 _FLUSH_AT = 1 << 16  # buffered events per (app, channel) before compaction
 _MAX_EXACT_INT = 1 << 53  # beyond float64 exactness -> JSON side-channel
+
+#: a WAL group commit whose write+flush takes at least this long is a
+#: STALL — journaled so ingest-latency spikes have a storage-side
+#: timeline (fsync contention, a saturated disk) to join against
+_WAL_STALL_S = 0.1
 
 
 def _wal_group_ms() -> float:
@@ -374,6 +380,11 @@ class _Shard:
                         "eventlog: dropping torn WAL tail record at %s "
                         "offset %d (%s) — the interrupted write was never "
                         "acknowledged", path, offset, e)
+                    journal.emit(
+                        "wal", "dropped torn WAL tail record (crash "
+                        "mid-append; the write was never acknowledged)",
+                        level=journal.WARN,
+                        path=path, offset=int(offset))
                 else:
                     logger.warning(
                         "eventlog: skipping corrupt WAL record at %s "
@@ -402,6 +413,12 @@ class _Shard:
                 label, path, size - consumed)
             with open(path, "r+b") as f:
                 f.truncate(consumed)
+            journal.emit(
+                "wal", f"repaired torn {label} tail (truncated "
+                "unacknowledged bytes left by a crash)",
+                level=journal.WARN,
+                path=path, label=label,
+                droppedBytes=int(size - consumed))
 
     def append_wal(self, events: Sequence[Event],
                    fsync: bool = False) -> None:
@@ -796,6 +813,17 @@ class EventlogEvents(Events):
                 WAL_GROUP_STATS["flush_s"] += dt
                 if len(group.lines) > WAL_GROUP_STATS["max_events"]:
                     WAL_GROUP_STATS["max_events"] = len(group.lines)
+                if dt >= _WAL_STALL_S:
+                    # every waiter of this group (and its acks) ate
+                    # this latency — that's an ingest-p99 event, worth
+                    # a timeline entry
+                    journal.emit(
+                        "wal", "WAL group commit stall: write+flush "
+                        f"took {dt * 1e3:.0f} ms for "
+                        f"{len(group.lines)} event(s)",
+                        level=journal.WARN,
+                        flushMs=round(dt * 1e3, 1),
+                        events=len(group.lines))
                 from predictionio_tpu.common import telemetry
                 if telemetry.on():
                     reg = telemetry.registry()

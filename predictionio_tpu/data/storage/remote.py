@@ -678,6 +678,14 @@ class StorageClient:
                     self.breaker.record(False)
                 if not (idempotent
                         and self.policy.may_retry(attempt, deadline)):
+                    if attempt > 0:
+                        # a RETRIED call giving up is journal history
+                        # (first-try failures are the ordinary error
+                        # path); sys.exc_info avoids rebinding the
+                        # in-flight exception
+                        import sys
+                        resilience.note_retries_exhausted(
+                            route, attempt + 1, sys.exc_info()[1])
                     raise
                 if telemetry.on():
                     _rpc_retries().labels(kind="transport").inc()

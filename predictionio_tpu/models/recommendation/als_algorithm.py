@@ -347,9 +347,17 @@ class ALSAlgorithm(Algorithm):
                         "KNOWN_ISSUES #12); serving fp32",
                         parity["k"], parity["recall"],
                         quant_mod.recall_floor())
+                    quant_mod.note_fallback(
+                        "ranking-parity probe below the floor "
+                        "(KNOWN_ISSUES #12)",
+                        recall=round(parity["recall"], 4),
+                        floor=quant_mod.recall_floor(), k=parity["k"])
                     qf = None
-            except Exception:
+            except Exception as e:
                 log.exception("factor quantization failed; serving fp32")
+                quant_mod.note_fallback(
+                    "factor quantization raised",
+                    error=f"{type(e).__name__}: {e}")
                 qf = None
 
         if serve_dist.serving_enabled():
@@ -382,9 +390,12 @@ class ALSAlgorithm(Algorithm):
                     user_vocab=model.user_vocab,
                     item_vocab=model.item_vocab,
                     quant=qs)
-            except Exception:
+            except Exception as e:
                 log.exception("quantized serving layout failed; "
                               "falling back to fp32 serving")
+                quant_mod.note_fallback(
+                    "int8 device layout failed",
+                    error=f"{type(e).__name__}: {e}")
 
         try:
             U = jax.device_put(np.asarray(model.user_factors))

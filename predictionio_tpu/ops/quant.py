@@ -179,6 +179,16 @@ def recall_floor() -> float:
         return 0.99
 
 
+def note_fallback(reason: str, **fields: Any) -> None:
+    """Journal a quantized-serving fallback to fp32 (probe refusal,
+    failed quantization, failed int8 layout): the operator asked for
+    the 4x-smaller footprint and is not getting it — `pio doctor`
+    WARNs on the live state, this records WHEN and WHY it happened."""
+    from predictionio_tpu.common import journal
+    journal.emit("quant", f"quantized serving fell back to fp32: "
+                 f"{reason}", level=journal.WARN, reason=reason, **fields)
+
+
 def accept_parity(parity: Dict[str, Any],
                   mode: Optional[str] = None) -> bool:
     """Does this probe result clear the deploy gate? "on" always serves

@@ -454,6 +454,13 @@ def prebuild(specs: Iterable[ProgramSpec],
                     "AOT prebuild of %s failed (%s: %s); the program "
                     "will compile lazily on first dispatch",
                     key_str, type(e).__name__, e)
+                from predictionio_tpu.common import journal
+                journal.emit(
+                    "aot",
+                    f"AOT prebuild of {spec.name} failed; it will "
+                    "compile lazily on the latency path",
+                    level=journal.WARN, program=key_str,
+                    error=f"{type(e).__name__}: {e}")
         m_programs.labels(status=status).inc()
         with lock:
             results.append((key_str, status, round(dt, 4)))

@@ -12,6 +12,9 @@ all directions:
   nowhere in the code — documentation for a knob that does nothing.
 - ``env-undocumented`` / ``metric-undocumented``: a declaration missing
   from README.md — a knob operators cannot discover.
+- ``journal-undeclared``: a ``journal.emit(category=...)`` call site
+  whose category is not declared in ``JOURNAL_CATEGORIES`` — a typo'd
+  category produces a timeline no operator's filter ever finds.
 
 Detection is AST-shaped, not grep-shaped: an env READ is a call on an
 environ-like object (``os.environ.get/pop/setdefault``, ``os.getenv``,
@@ -43,6 +46,7 @@ _ENV_UNDOC = "env-undocumented"
 _MET_UNDECLARED = "metric-undeclared"
 _MET_GHOST = "metric-ghost"
 _MET_UNDOC = "metric-undocumented"
+_JOURNAL_UNDECLARED = "journal-undeclared"
 
 _DECL_REL = "predictionio_tpu/common/declarations.py"
 
@@ -100,10 +104,42 @@ def metric_registrations(mod: Module) -> List[Tuple[str, int]]:
     return out
 
 
+def journal_emits(mod: Module) -> List[Tuple[Optional[str], int]]:
+    """(category-literal-or-None, line) for every ``journal.emit(...)``
+    call: the category is the first positional argument or the
+    ``category=`` keyword. None means dynamically composed — the rule
+    abstains (same posture as dynamic env names)."""
+    assert mod.tree is not None
+    out: List[Tuple[Optional[str], int]] = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"):
+            continue
+        owner = dotted_name(node.func.value) or ""
+        if owner.split(".")[-1] != "journal":
+            continue
+        arg: Optional[ast.AST] = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "category":
+                arg = kw.value
+        if arg is None:
+            continue
+        cat = (arg.value if isinstance(arg, ast.Constant)
+               and isinstance(arg.value, str) else None)
+        out.append((cat, node.lineno))
+    return out
+
+
 def _declared() -> Tuple[Dict[str, str], Dict[str, str], Dict[str, str]]:
     from predictionio_tpu.common import declarations
     return (declarations.env_exact(), declarations.env_prefixes(),
             dict(declarations.METRICS))
+
+
+def _declared_journal_categories() -> Dict[str, str]:
+    from predictionio_tpu.common import declarations
+    return dict(getattr(declarations, "JOURNAL_CATEGORIES", {}))
 
 
 def _readme_text(root: Optional[str]) -> str:
@@ -161,6 +197,23 @@ def run(modules: Sequence[Module],
                         hint="declare it in declarations.METRICS and "
                              "document it in README",
                         detail=name))
+        if "journal" in mod.source:
+            categories = _declared_journal_categories()
+            for cat, line in journal_emits(mod):
+                if (cat is not None and cat not in categories
+                        and not mod.line_allows(line,
+                                                _JOURNAL_UNDECLARED)):
+                    out.append(Finding(
+                        rule=_JOURNAL_UNDECLARED, path=mod.rel,
+                        line=line,
+                        message=f"journal category {cat!r} is emitted "
+                                "but not declared in "
+                                "declarations.JOURNAL_CATEGORIES",
+                        hint="declare the category with a one-line "
+                             "meaning (or fix the typo — an "
+                             "undeclared category is a timeline no "
+                             "operator filter finds)",
+                        detail=cat))
 
     # dead / ghost / undocumented are properties of the registry
     # itself: only judged when the analyzed tree CONTAINS the registry
@@ -231,7 +284,9 @@ def _decl_lines(decl_source: str) -> Dict[str, int]:
 PASS = Pass(
     name="declarations",
     rules=(_ENV_UNDECLARED, _ENV_DEAD, _ENV_UNDOC,
-           _MET_UNDECLARED, _MET_GHOST, _MET_UNDOC),
-    doc="every PIO_* env read and pio_* metric is declared in "
-        "common/declarations.py and documented in README",
+           _MET_UNDECLARED, _MET_GHOST, _MET_UNDOC,
+           _JOURNAL_UNDECLARED),
+    doc="every PIO_* env read, pio_* metric, and journal.emit category "
+        "is declared in common/declarations.py and documented in "
+        "README",
     run=run)

@@ -79,6 +79,14 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # bench's own waterfall leg under BENCH_STRICT_EXTRAS=1
     ("waterfall_overhead_p99_pct", "down", False),
     ("waterfall_on_p99_ms", "down", False),
+    # flight-recorder era (common/journal.py + tracing tail retention):
+    # the journal-on path's p99 tax (hard-gated at <= 5% by the bench's
+    # own journal leg under BENCH_STRICT_EXTRAS=1), the event count,
+    # and how many traces the tail ring pinned — trended so emitter
+    # creep (a hot path that starts journaling) is visible per round
+    ("journal_overhead_p99_pct", "down", False),
+    ("journal_events_total", "up", False),
+    ("trace_tail_retained", "up", False),
     # sharded-serving era (parallel/serve_dist.py): the row-sharded
     # top-k path's p99 and its overhead vs the replicated path —
     # hard-gated at <= 10% by the bench's serve-sharded leg under

@@ -260,6 +260,40 @@ def cmd_doctor(args) -> int:
     return run_doctor(url, timeout=args.timeout)
 
 
+def _parse_targets(raw: str) -> List[str]:
+    targets = [t.strip() for t in (raw or "").split(",") if t.strip()]
+    if not targets:
+        raise CommandError(
+            "--targets requires at least one daemon base URL "
+            "(comma-separated, e.g. "
+            "http://host:8000,http://host:7070)")
+    return targets
+
+
+def cmd_trace(args) -> int:
+    """Fleet trace assembly (common/traceview.py): fan a trace id out
+    to every target's /traces.json?trace_id=, join the spans across
+    processes with client/server clock-skew correction, and render ONE
+    waterfall tree. Exit 0 assembled / 1 not found / 2 all targets
+    unreachable."""
+    from predictionio_tpu.common.traceview import run_trace
+    return run_trace(args.trace_id, _parse_targets(args.targets),
+                     timeout=args.timeout)
+
+
+def cmd_events(args) -> int:
+    """Fleet journal merge-tail (common/traceview.py): read every
+    target's /debug/events.json (incremental since_seq cursors) and
+    print the merged timeline oldest-first; --follow keeps polling.
+    Exit 0 / 2 when every target is unreachable."""
+    from predictionio_tpu.common.traceview import run_events
+    return run_events(
+        _parse_targets(args.targets), since_seq=args.since_seq,
+        category=args.category or None, level=args.level or None,
+        follow=args.follow, interval_s=args.interval,
+        timeout=args.timeout)
+
+
 def cmd_lint(args) -> int:
     """Repo-wide static analysis (tools/analyze): the KNOWN_ISSUES
     invariants as lint passes — timing honesty, implicit host syncs,
@@ -714,6 +748,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-request timeout in seconds")
 
     sp = sub.add_parser(
+        "trace",
+        help="assemble one trace id across a daemon fleet into a "
+             "single waterfall tree (fans out to every target's "
+             "/traces.json?trace_id=, joins spans with clock-skew "
+             "correction; exit 0 assembled / 1 not found / 2 "
+             "unreachable)")
+    sp.add_argument("trace_id", help="the 16-hex trace id (from "
+                    "/debug/slow.json, a /metrics exemplar, a journal "
+                    "event, or an X-PIO-Trace header)")
+    sp.add_argument("--targets", required=True,
+                    help="comma-separated daemon base URLs (query, "
+                         "storage, event servers)")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-target timeout in seconds")
+
+    sp = sub.add_parser(
+        "events",
+        help="merge-tail the operational journals "
+             "(/debug/events.json) of a daemon fleet by timestamp "
+             "(exit 0 / 2 when every target is unreachable)")
+    sp.add_argument("--targets", required=True,
+                    help="comma-separated daemon base URLs")
+    sp.add_argument("--since-seq", type=int, default=0,
+                    help="only events with seq beyond this cursor "
+                         "(per target; default 0 = everything buffered)")
+    sp.add_argument("--level", default="",
+                    help="minimum severity: info (default) / warn / red")
+    sp.add_argument("--category", default="",
+                    help="narrow to one journal category (see the "
+                         "README flight-recorder table)")
+    sp.add_argument("--follow", action="store_true",
+                    help="keep polling for new events (Ctrl-C to stop)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll interval in seconds")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-target timeout in seconds")
+
+    sp = sub.add_parser(
         "lint",
         help="repo-wide static analysis of the KNOWN_ISSUES invariants "
              "(tools/analyze; exit 0 clean / 1 findings / 2 internal "
@@ -835,6 +907,8 @@ _DISPATCH = {
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
     "doctor": cmd_doctor,
+    "trace": cmd_trace,
+    "events": cmd_events,
     "lint": cmd_lint,
     "profile": cmd_profile,
     "run": cmd_run,

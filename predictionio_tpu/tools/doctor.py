@@ -2,10 +2,12 @@
 
 Scrapes a daemon's observability surface (`/healthz`, `/readyz`,
 `/metrics`, `/traces.json?limit=8`, `/debug/device.json`,
-`/debug/slow.json?limit=3`) and renders every check on one screen with
-a green/warn/red state — including the SLO burn-rate verdict
-(common/slo.py: RED when the fast window is alight) and the latency
-waterfall's slowest sampled request:
+`/debug/slow.json?limit=3`, `/debug/events.json?level=warn&limit=8`)
+and renders every check on one screen with a green/warn/red state —
+including the SLO burn-rate verdict (common/slo.py: RED when the fast
+window is alight), the latency waterfall's slowest sampled request,
+and the flight recorder's recent WARN/RED events with ages (the
+alarm -> timeline link; drill down with `pio events` / `pio trace`):
 
     $ pio doctor http://localhost:8000
     pio doctor — http://localhost:8000 (QueryAPI)
@@ -158,7 +160,8 @@ def scrape(base_url: str, timeout: float = 5.0) -> Dict[str, Any]:
                       ("metrics", "/metrics"),
                       ("traces", "/traces.json?limit=8"),
                       ("device", "/debug/device.json"),
-                      ("slow", "/debug/slow.json?limit=3")):
+                      ("slow", "/debug/slow.json?limit=3"),
+                      ("events", "/debug/events.json?level=warn&limit=8")):
         status, body = _get(base_url, path, timeout)
         out[key] = {"status": status, "body": body}
     return out
@@ -498,7 +501,49 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
         else:
             checks.append(("waterfall", OK,
                            "sampling on, no requests recorded yet"))
+
+    # recent operational events (common/journal.py flight recorder) ----
+    # the alarm -> timeline link: the last WARN/RED journal entries with
+    # ages, so every RED check above has its "when did this start"
+    # evidence one line away (drill down: pio events --targets <url>)
+    ev = _json_body(scraped.get("events", {}))
+    if ev is None:
+        checks.append(("events", NA,
+                       "no /debug/events.json (old daemon?)"))
+    elif not ev.get("enabled", False):
+        checks.append(("events", NA,
+                       "journal off (PIO_JOURNAL=0) — no operational "
+                       "timeline"))
+    else:
+        entries = ev.get("events") or []
+        if not entries:
+            checks.append(("events", OK,
+                           "no WARN/RED journal events recorded"))
+        else:
+            import datetime as _dtmod
+            now = _dtmod.datetime.now(
+                _dtmod.timezone.utc).timestamp()
+            recent = entries[-3:]
+            detail = "; ".join(
+                f"[{e.get('level', '?')}] {e.get('category', '?')}: "
+                f"{e.get('message', '')} ({_age(e.get('ts'), now)} ago)"
+                for e in recent)
+            # a RED event in the last 10 minutes is the "look here
+            # next" tier — WARN, never RED: the live-state checks above
+            # own paging (the breaker may have closed since)
+            hot = any(e.get("level") == "red"
+                      and now - (e.get("ts") or 0) < 600
+                      for e in entries)
+            checks.append(("events", WARN if hot else OK,
+                           f"last {len(recent)} WARN/RED: {detail}"))
     return checks
+
+
+def _age(ts: Optional[float], now: float) -> str:
+    if not ts:
+        return "?"
+    from predictionio_tpu.common.traceview import age_str
+    return age_str(float(ts), now=now)
 
 
 def render(scraped: Dict[str, Any],

@@ -30,7 +30,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.common import (
-    devicewatch, resilience, slo, telemetry, tracing, waterfall,
+    devicewatch, journal, resilience, slo, telemetry, tracing, waterfall,
 )
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
@@ -218,6 +218,13 @@ class QueryAPI:
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self.start_time = utcnow()
+        #: model generation: bumped on every successful _load (initial
+        #: deploy = 1, each /reload hot-swap +1). The journal's
+        #: lifecycle events carry it, so "which model answered this?"
+        #: joins against "when did that generation land?" — the
+        #: zero-downtime hot-swap ROADMAP item reports into exactly
+        #: this field.
+        self.generation = 0
         # degraded accounting is registry-backed (single source of truth
         # for GET / and GET /metrics), per-instance labeled so a fresh
         # server starts at zero. TWO metrics because the batched serving
@@ -327,12 +334,22 @@ class QueryAPI:
             old_batcher.close()
         self.time_to_ready_s = time.perf_counter() - t_load
         self._m_time_to_ready.set(self.time_to_ready_s)
+        self.generation += 1
         logger.info("Engine instance %s deployed (%d algorithm(s), "
                     "batching %s, aot %s) in %.2fs", instance.id,
                     len(algorithms),
                     "on" if batcher is not None else "off",
                     "on" if aot_state is not None else "off",
                     self.time_to_ready_s)
+        journal.emit(
+            "lifecycle",
+            (f"model generation {self.generation} live "
+             f"({'reload hot-swap' if is_reload else 'initial deploy'}: "
+             f"instance {instance.id})"),
+            level=journal.INFO,
+            generation=self.generation, instanceId=instance.id,
+            reload=bool(is_reload),
+            timeToReadyS=round(self.time_to_ready_s, 3))
 
     def _prebuild_aot(self, instance, algorithms, models):
         """Kill the warmup cliff before /readyz flips ready
@@ -464,6 +481,10 @@ class QueryAPI:
             return
         self._draining.set()
         logger.info("drain: stopped admitting; flushing batcher")
+        journal.emit("lifecycle", "drain begin: stopped admitting "
+                     "queries; flushing admitted batches",
+                     level=journal.INFO, generation=self.generation)
+        t0 = time.perf_counter()
         with self._lock:
             batcher = self._batcher
         if batcher is not None:
@@ -471,6 +492,10 @@ class QueryAPI:
                           else self.config.drain_grace_s)
         self._stop_requested.set()
         logger.info("drain: complete")
+        journal.emit("lifecycle", "drain complete: every admitted "
+                     "in-flight request answered",
+                     level=journal.INFO, generation=self.generation,
+                     drainS=round(time.perf_counter() - t0, 3))
 
     def close(self) -> None:
         """Drain and retire the request batcher (server shutdown). Queries
@@ -598,8 +623,14 @@ class QueryAPI:
     def _reload(self) -> None:
         try:
             self._load()
-        except Exception:
+        except Exception as e:
             logger.exception("reload failed; keeping previous engine")
+            journal.emit(
+                "lifecycle",
+                f"reload FAILED; generation {self.generation} keeps "
+                "serving",
+                level=journal.WARN, generation=self.generation,
+                error=f"{type(e).__name__}: {e}")
 
     # ---------------------------------------------------------- query path
     def _queries(self, body: bytes) -> Response:
@@ -668,6 +699,9 @@ class QueryAPI:
             # whole flush is tainted), hence "upper bound" in the metric
             # name and the KNOWN_ISSUES #6 caveat on degradedCount
             self._m_degraded_queries.inc()
+            # a degraded answer is a trace worth keeping: pin it in the
+            # tail ring so its id resolves after the main ring churns
+            tracing.pin_current("degraded")
             if batcher is None:
                 # inline path: a degraded query IS a degraded "batch" of 1
                 self._m_degraded_batches.inc()

@@ -1,0 +1,55 @@
+"""Shared fixture code for the flight-recorder tests (test_journal.py,
+test_traceview.py): seed, train, and deploy a small recommendation
+engine — the test_telemetry.py recipe, factored out so both new suites
+reuse one trainer."""
+
+import datetime as dt
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+)
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+
+def train_engine(storage, app_name="JournalApp"):
+    """Seed ratings + train one small ALS instance; returns the engine."""
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, app_name, None))
+    storage.get_events().init(app_id)
+    events = []
+    for u in range(8):
+        for i in range(6):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": 5.0 if (u % 2) == (i % 2) else 1.0}),
+                event_time=dt.datetime(2021, 1, 1, 0, (u * 6 + i) % 60,
+                                       tzinfo=dt.timezone.utc)))
+    storage.get_events().insert_batch(events, app_id)
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName=app_name),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=4, numIterations=3,
+                                       lambda_=0.05, seed=3)),))
+    run_train(WorkflowContext(storage=storage), engine, ep,
+              engine_factory="journal-test",
+              params_json={
+                  "datasource": {"params": {"appName": app_name}},
+                  "algorithms": [{"name": "als", "params": {
+                      "rank": 4, "numIterations": 3, "lambda": 0.05,
+                      "seed": 3}}]})
+    return engine
+
+
+def trained_query_api(storage, **config):
+    """A deployed QueryAPI over a freshly-trained engine."""
+    engine = train_engine(storage)
+    return QueryAPI(storage=storage, engine=engine,
+                    config=ServerConfig(**config))

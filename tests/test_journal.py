@@ -1,0 +1,414 @@
+"""Flight recorder (common/journal.py): the operational-event journal.
+
+Covers the acceptance surface: emit/snapshot mechanics (monotonic seq,
+``since_seq`` pagination, category + minimum-level filters, bounded
+eviction that never renumbers), the ``/debug/events.json`` route on all
+three daemons, WIRE PARITY (journal off -> existing responses byte-
+identical, the endpoint answers ``enabled: false``), and every wired
+emitter: breaker transitions, retry exhaustion, degraded flips, WAL
+torn-tail repair, group-commit stalls, model load/reload generations,
+drain begin/end, quant fallback, AOT prebuild failures, post-warmup
+recompiles, and SLO burn-rate crossings — the chaos-suite shapes
+(breaker open, WAL repair) asserted through the wire surface of all
+three daemons.
+"""
+
+import io
+import json
+import os
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.common import (
+    journal, resilience, telemetry, tracing,
+)
+from predictionio_tpu.common.resilience import CircuitBreaker, RetryPolicy
+from predictionio_tpu.data.api import EventAPI
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.remote import StorageRPCAPI
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    journal.set_enabled(None)
+    journal.clear()
+    telemetry.set_enabled(None)
+    tracing.set_enabled(None)
+    tracing.clear()
+    yield
+    journal.set_enabled(None)
+    journal.clear()
+    telemetry.set_enabled(None)
+    tracing.set_enabled(None)
+    tracing.clear()
+
+
+# ---------------------------------------------------------------------------
+# journal mechanics
+# ---------------------------------------------------------------------------
+
+def test_emit_and_snapshot_basics():
+    s1 = journal.emit("breaker", "opened", level=journal.RED,
+                      endpoint="ep")
+    s2 = journal.emit("wal", "repaired", level=journal.WARN, bytes=12)
+    s3 = journal.emit("lifecycle", "gen 1 live")
+    assert (s1, s2, s3) == (1, 2, 3)
+    snap = journal.snapshot()
+    assert snap["enabled"] is True
+    assert snap["lastSeq"] == 3
+    assert [e["seq"] for e in snap["events"]] == [1, 2, 3]
+    first = snap["events"][0]
+    assert first["category"] == "breaker" and first["level"] == "red"
+    assert first["fields"] == {"endpoint": "ep"}
+    assert "at" in first and "ts" in first
+
+
+def test_since_seq_pagination_and_filters():
+    journal.emit("breaker", "opened", level=journal.RED)
+    journal.emit("wal", "stall", level=journal.WARN)
+    journal.emit("lifecycle", "gen 1 live")     # info
+    # since_seq: strictly-greater cursor — the follower contract
+    assert [e["seq"] for e in
+            journal.snapshot(since_seq=1)["events"]] == [2, 3]
+    assert not journal.snapshot(since_seq=3)["events"]
+    # category narrows to one subsystem
+    assert [e["category"] for e in
+            journal.snapshot(category="wal")["events"]] == ["wal"]
+    # level is a MINIMUM severity: warn returns warn+red
+    assert [e["level"] for e in
+            journal.snapshot(level="warn")["events"]] == ["red", "warn"]
+    assert [e["level"] for e in
+            journal.snapshot(level="red")["events"]] == ["red"]
+    # limit keeps the NEWEST records
+    assert [e["seq"] for e in
+            journal.snapshot(limit=2)["events"]] == [2, 3]
+
+
+def test_bounded_eviction_keeps_seq_monotonic(monkeypatch):
+    monkeypatch.setenv("PIO_JOURNAL_BUFFER", "16")
+    for k in range(40):
+        journal.emit("lifecycle", f"event {k}")
+    snap = journal.snapshot()
+    assert snap["capacity"] == 16
+    assert len(snap["events"]) == 16
+    # old records fell off; seq NEVER renumbers (cursors stay valid)
+    assert [e["seq"] for e in snap["events"]] == list(range(25, 41))
+    assert snap["lastSeq"] == 40
+
+
+def test_disabled_journal_records_nothing(monkeypatch):
+    journal.set_enabled(False)
+    assert journal.emit("breaker", "opened") is None
+    snap = journal.snapshot()
+    assert snap["enabled"] is False and snap["events"] == []
+    journal.set_enabled(None)
+    monkeypatch.setenv("PIO_JOURNAL", "0")
+    assert journal.emit("breaker", "opened") is None
+    assert not journal.snapshot()["events"]
+
+
+def test_emit_captures_and_pins_active_trace():
+    tracing.set_enabled(True)
+    ctx = tracing.new_context()
+    with tracing.activate(ctx):
+        journal.emit("wal", "repaired", level=journal.WARN)
+    snap = journal.snapshot()
+    assert snap["events"][-1]["traceId"] == ctx.trace_id
+    # the journal reference pinned the trace in the tail ring
+    assert f"journal:wal" in tracing._tail.reasons_for(ctx.trace_id)
+
+
+def test_emit_metric_gated_on_telemetry():
+    telemetry.set_enabled(True)
+    journal.emit("wal", "stall", level=journal.WARN)
+    reg = telemetry.registry()
+    fam = reg._families.get("pio_journal_events_total")
+    assert fam is not None
+    val = fam.labels(category="wal", level="warn").value
+    assert val >= 1
+
+
+# ---------------------------------------------------------------------------
+# the wire surface: /debug/events.json on every daemon
+# ---------------------------------------------------------------------------
+
+def _mk_event(eid="u1", iid="i1"):
+    return Event(event="rate", entity_type="user", entity_id=eid,
+                 target_entity_type="item", target_entity_id=iid,
+                 properties=DataMap({"rating": 2.0}))
+
+
+def test_events_route_params_and_validation(memory_storage):
+    api = EventAPI(storage=memory_storage)
+    journal.emit("breaker", "opened", level=journal.RED)
+    journal.emit("wal", "stall", level=journal.WARN)
+    st, snap = api.handle("GET", "/debug/events.json")
+    assert st == 200 and len(snap["events"]) == 2
+    st, snap = api.handle("GET", "/debug/events.json",
+                          {"since_seq": "1"})
+    assert st == 200 and [e["seq"] for e in snap["events"]] == [2]
+    st, snap = api.handle("GET", "/debug/events.json",
+                          {"category": "breaker"})
+    assert st == 200 and len(snap["events"]) == 1
+    st, snap = api.handle("GET", "/debug/events.json", {"level": "red"})
+    assert st == 200 and len(snap["events"]) == 1
+    st, err = api.handle("GET", "/debug/events.json",
+                         {"since_seq": "bogus"})
+    assert st == 400
+    st, err = api.handle("GET", "/debug/events.json", {"level": "loud"})
+    assert st == 400
+    st, err = api.handle("GET", "/debug/events.json", {"limit": "x"})
+    assert st == 400
+
+
+def test_chaos_shapes_visible_on_all_three_daemons(memory_storage,
+                                                   tmp_path):
+    """THE acceptance read: a breaker-open and a WAL torn-tail repair
+    (the chaos suite's injected shapes) show up in /debug/events.json
+    on the query, event, AND storage daemons."""
+    from journal_test_util import trained_query_api
+
+    # breaker open: drive a shared breaker over its error threshold
+    br = CircuitBreaker("evlog", window_s=30, error_threshold=0.5,
+                        min_calls=4, open_s=5)
+    for _ in range(4):
+        br.record(False)
+    assert br.state == CircuitBreaker.OPEN
+
+    # WAL torn-tail repair: tear the WAL mid-record, then reopen+insert
+    env = {
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    }
+    s1 = Storage(env=env)
+    from predictionio_tpu.data.storage import App
+    app_id = s1.get_meta_data_apps().insert(App(0, "JApp"))
+    ev1 = s1.get_events()
+    ev1.init(app_id)
+    ev1.insert_batch([_mk_event("u1"), _mk_event("u2")], app_id)
+    sh = ev1._shard(app_id, None)
+    wal = sh.wal_path_for(sh.next_seq)
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.truncate(size - 10)
+    s2 = Storage(env=env)
+    s2.get_events().insert(_mk_event("u3"), app_id)   # repairs the tail
+
+    query_api = trained_query_api(memory_storage)
+    event_api = EventAPI(storage=memory_storage)
+    storage_api = StorageRPCAPI(memory_storage, key="sekrit")
+    try:
+        for api in (query_api, event_api, storage_api):
+            st, snap = api.handle("GET", "/debug/events.json",
+                                  {"level": "warn"})
+            assert st == 200, type(api).__name__
+            cats = {e["category"] for e in snap["events"]}
+            assert "breaker" in cats, (type(api).__name__, snap)
+            assert "wal" in cats, (type(api).__name__, snap)
+            opened = [e for e in snap["events"]
+                      if e["category"] == "breaker"
+                      and e["fields"].get("to") == "open"]
+            assert opened and opened[0]["level"] == "red"
+            repaired = [e for e in snap["events"]
+                        if e["category"] == "wal"
+                        and "torn" in e["message"]]
+            assert repaired
+    finally:
+        query_api.close()
+
+
+def test_wire_parity_journal_off(memory_storage):
+    """PIO_JOURNAL=0: existing endpoints' bytes are unchanged (the
+    journal only ever ADDS /debug/events.json, which then answers
+    enabled:false with no events)."""
+    from journal_test_util import trained_query_api
+    api = trained_query_api(memory_storage)
+    server, port = serve_background(api)
+    body = json.dumps({"user": "u1", "num": 3}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            f"http://localhost:{port}/queries.json", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read()
+
+    try:
+        journal.set_enabled(True)
+        st_on, bytes_on = post()
+        journal.set_enabled(False)
+        st_off, bytes_off = post()
+        assert st_on == st_off == 200
+        assert bytes_on == bytes_off
+        # off stops RECORDING (history already buffered stays readable);
+        # nothing new lands while disabled
+        last = journal.snapshot()["lastSeq"]
+        journal.emit("lifecycle", "must not record")
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/events.json") as r:
+            snap = json.loads(r.read())
+        assert snap["enabled"] is False
+        assert snap["lastSeq"] == last
+        assert all(e["message"] != "must not record"
+                   for e in snap["events"])
+    finally:
+        server.shutdown()
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# emitters: one test per wired subsystem
+# ---------------------------------------------------------------------------
+
+def test_breaker_lifecycle_emits_transitions():
+    clock = [0.0]
+    br = CircuitBreaker("ep1", window_s=30, error_threshold=0.5,
+                        min_calls=2, open_s=5,
+                        clock=lambda: clock[0])
+    br.record(False)
+    br.record(False)            # -> open (red)
+    clock[0] += 6.0
+    br.allow()                  # -> half-open probe admitted (warn)
+    br.record(True)             # -> closed (info)
+    events = [e for e in journal.snapshot(category="breaker")["events"]
+              if e["fields"].get("endpoint") == "ep1"]
+    assert [(e["fields"]["to"], e["level"]) for e in events] == [
+        ("open", "red"), ("half-open", "warn"), ("closed", "info")]
+
+
+def test_retry_exhaustion_emits():
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+
+    def always_fails():
+        raise ConnectionError("nope")
+
+    with pytest.raises(ConnectionError):
+        policy.call(always_fails, sleep=lambda s: None)
+    events = journal.snapshot(category="retry")["events"]
+    assert len(events) == 1
+    assert events[0]["level"] == "warn"
+    assert events[0]["fields"]["attempts"] == 3
+
+
+def test_first_try_failure_is_not_journaled():
+    """A no-retry policy failing its only attempt is the caller's
+    ordinary error path, not retry exhaustion."""
+    policy = RetryPolicy(max_attempts=1)
+    with pytest.raises(ConnectionError):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                    sleep=lambda s: None)
+    assert not journal.snapshot(category="retry")["events"]
+
+
+def test_degraded_flip_emits():
+    resilience.reset_degraded()
+    resilience.note_degraded("side-channel lookup failed")
+    events = journal.snapshot(category="degraded")["events"]
+    assert events and events[-1]["level"] == "warn"
+    assert "side-channel" in events[-1]["fields"]["reason"]
+    resilience.pop_degraded()
+
+
+def test_wal_group_commit_stall_emits(monkeypatch, tmp_path):
+    from predictionio_tpu.data.storage import eventlog as el
+    monkeypatch.setattr(el, "_WAL_STALL_S", 0.0)   # every commit stalls
+    monkeypatch.setenv("PIO_WAL_GROUP_MS", "1")
+    env = {
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    }
+    s = Storage(env=env)
+    from predictionio_tpu.data.storage import App
+    app_id = s.get_meta_data_apps().insert(App(0, "StallApp"))
+    s.get_events().init(app_id)
+    s.get_events().insert_batch([_mk_event()], app_id)
+    events = journal.snapshot(category="wal")["events"]
+    assert any("stall" in e["message"] for e in events), events
+
+
+def test_lifecycle_generation_reload_and_drain(memory_storage):
+    from journal_test_util import trained_query_api
+    api = trained_query_api(memory_storage)
+    try:
+        life = journal.snapshot(category="lifecycle")["events"]
+        gens = [e for e in life if "generation" in e["fields"]]
+        assert gens and gens[-1]["fields"]["generation"] == 1
+        assert gens[-1]["fields"]["reload"] is False
+        assert api.generation == 1
+        api._reload()      # synchronous hot-swap
+        life = journal.snapshot(category="lifecycle")["events"]
+        gens = [e for e in life if "generation" in e["fields"]
+                and e["fields"].get("reload") is True]
+        assert gens and gens[-1]["fields"]["generation"] == 2
+        api.drain(grace_s=5.0)
+        msgs = [e["message"] for e in
+                journal.snapshot(category="lifecycle")["events"]]
+        assert any("drain begin" in m for m in msgs)
+        assert any("drain complete" in m for m in msgs)
+    finally:
+        api.close()
+
+
+def test_quant_fallback_emits():
+    from predictionio_tpu.ops import quant
+    quant.note_fallback("ranking-parity probe below the floor",
+                        recall=0.95, floor=0.99)
+    events = journal.snapshot(category="quant")["events"]
+    assert events and events[-1]["level"] == "warn"
+    assert events[-1]["fields"]["recall"] == 0.95
+
+
+def test_aot_prebuild_failure_emits():
+    from predictionio_tpu.serving import aot
+
+    def boom():
+        raise RuntimeError("no such kernel")
+
+    spec = aot.ProgramSpec(name="journal_test_kernel",
+                           key=("journal_test_kernel", 1),
+                           lower=boom, prime=boom)
+    report = aot.prebuild([spec], threads=1)
+    assert any(status == "failed" for _k, status, _s in report.programs)
+    events = journal.snapshot(category="aot")["events"]
+    assert events and events[-1]["level"] == "warn"
+    assert "journal_test_kernel" in events[-1]["fields"]["program"]
+
+
+def test_post_warmup_recompile_emits():
+    from predictionio_tpu.common import devicewatch
+    telemetry.set_enabled(True)
+    devicewatch._note_post_warmup("serve_flush", "flush:n=3,k=10", 0.4)
+    events = journal.snapshot(category="recompile")["events"]
+    assert events and events[-1]["level"] == "red"
+    assert events[-1]["fields"]["signature"] == "flush:n=3,k=10"
+
+
+def test_slo_crossing_emits_edges_not_levels():
+    from predictionio_tpu.common.slo import SLOEngine
+    eng = SLOEngine()
+    hot = {"availability": {"burn_fast": 20.0, "burn_slow": 1.0}}
+    eng._note_crossings(hot)
+    eng._note_crossings(hot)     # sustained burn: NO second event
+    events = journal.snapshot(category="slo")["events"]
+    assert len(events) == 1 and events[0]["level"] == "red"
+    cool = {"availability": {"burn_fast": 0.1, "burn_slow": 1.0}}
+    eng._note_crossings(cool)    # recovery edge
+    events = journal.snapshot(category="slo")["events"]
+    assert len(events) == 2 and events[1]["level"] == "info"
+    assert "subsided" in events[1]["message"]
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

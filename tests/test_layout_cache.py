@@ -59,6 +59,23 @@ def test_big_layout_cache_hits_and_invalidates(monkeypatch):
     assert len(calls) == 2
 
 
+def test_layout_digest_distinguishes_same_shape_different_content():
+    """Two different-content/same-shape event sets can never share a
+    cache entry: the cheap meta prefix (nnz, vocab sizes) collides by
+    construction, so only the blake2b content digest separates them —
+    the 128-bit guarantee the PR 1 fingerprint change bought (the old
+    32-bit CRC left a ~2^-32 silent-stale-layout window)."""
+    from predictionio_tpu.models.recommendation import als_algorithm
+    td_a = _mk_td(seed=0)
+    td_b = _mk_td(seed=1)     # same n/vocab shapes, different contents
+    assert (als_algorithm._layout_meta(td_a, False)
+            == als_algorithm._layout_meta(td_b, False))
+    assert (als_algorithm._layout_crc(td_a)
+            != als_algorithm._layout_crc(td_b))
+    # and the digest is 16 bytes of blake2b, not a 4-byte CRC
+    assert len(als_algorithm._layout_crc(td_a)) == 16
+
+
 def test_big_layout_cache_disabled(monkeypatch):
     from predictionio_tpu.models.recommendation.als_algorithm import (
         ALSAlgorithm, ALSAlgorithmParams,

@@ -259,9 +259,35 @@ def test_declarations_pass_fires_on_unregistered_metric():
     assert len(found) == 1 and "pio_ghost_series_total" in found[0].message
 
 
+def test_declarations_pass_fires_on_undeclared_journal_category():
+    """The journal-category half of the declarations triangle: an emit
+    call site whose category is not in JOURNAL_CATEGORIES is a typo'd
+    timeline and fails the lint."""
+    src = ("from predictionio_tpu.common import journal\n"
+           "journal.emit('not_a_real_category_xyz', 'boom')\n")
+    found = [f for f in declarations.run([_mod(src)], readme_text="")
+             if f.rule == "journal-undeclared"]
+    assert len(found) == 1
+    assert "not_a_real_category_xyz" in found[0].message
+    # keyword spelling is caught too
+    src_kw = ("from predictionio_tpu.common import journal\n"
+              "journal.emit(category='also_bogus_xyz', message='x')\n")
+    found = [f for f in declarations.run([_mod(src_kw)], readme_text="")
+             if f.rule == "journal-undeclared"]
+    assert len(found) == 1 and "also_bogus_xyz" in found[0].message
+
+
+def test_declarations_pass_accepts_declared_journal_category():
+    src = ("from predictionio_tpu.common import journal\n"
+           "journal.emit('wal', 'repaired', level=journal.WARN)\n")
+    assert not [f for f in declarations.run([_mod(src)], readme_text="")
+                if f.rule == "journal-undeclared"]
+
+
 def test_declarations_pass_clean_on_real_repo_and_readme():
-    """Every PIO_* read and pio_* metric in the real tree is declared
-    in common/declarations.py and documented in README.md."""
+    """Every PIO_* read, pio_* metric, and journal.emit category in the
+    real tree is declared in common/declarations.py and (env/metric)
+    documented in README.md."""
     modules = [m for m in walker.discover(ROOT)]
     assert not declarations.run(modules)
 

@@ -1,0 +1,428 @@
+"""Fleet trace assembly (`pio trace`), journal merge-tail
+(`pio events`), and tail-based trace retention.
+
+The acceptance e2e: a query->storage request served by TWO live HTTP
+daemons (query server + storage RPC server) assembles into ONE span
+tree via `pio trace` fanning out to both /traces.json surfaces. Plus:
+clock-skew correction on constructed two-process spans, tail-ring
+retention of a slow trace across main-ring churn, error-pinning at the
+transport, `pio events` incremental merge, and CLI exit codes.
+"""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.common import journal, telemetry, tracing, traceview
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.data.api.http import (
+    dispatch_request, serve_background,
+)
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App, Storage
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams,
+)
+from predictionio_tpu.models.recommendation.als_algorithm import ALSAlgorithm
+from predictionio_tpu.tools.cli import main as cli_main
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.set_enabled(None)
+    tracing.set_enabled(None)
+    tracing.clear()
+    journal.set_enabled(None)
+    journal.clear()
+    yield
+    telemetry.set_enabled(None)
+    tracing.set_enabled(None)
+    tracing.clear()
+    journal.set_enabled(None)
+    journal.clear()
+
+
+# ---------------------------------------------------------------------------
+# clock-skew correction + tree rendering (constructed spans)
+# ---------------------------------------------------------------------------
+
+def _span(sid, pid, name, service, start, dur, target):
+    return {"spanId": sid, "parentId": pid, "name": name,
+            "service": service, "startMs": start, "durationMs": dur,
+            "target": target}
+
+
+def test_skew_correction_centers_server_inside_client():
+    """Process B's clock is 5 s ahead; after correction its spans sit
+    centered inside their client parents, and B's OTHER spans shift by
+    the same offset."""
+    spans = [
+        _span("a", None, "server:/queries.json", "QueryAPI",
+              1000.0, 10.0, "A"),
+        _span("b", "a", "storage", "rpc", 1002.0, 6.0, "A"),
+        _span("c", "b", "server:/rpc", "StorageRPCAPI",
+              6003.0, 4.0, "B"),
+        _span("d", "c", "disk", "StorageRPCAPI", 6004.0, 2.0, "B"),
+    ]
+    offsets = traceview.correct_skew(spans)
+    assert offsets["A"] == 0.0
+    assert offsets["B"] == pytest.approx(-5000.0)
+    by = {s["spanId"]: s for s in spans}
+    # c centered inside b: 1002 + (6-4)/2 = 1003
+    assert by["c"]["startMs"] == pytest.approx(1003.0)
+    assert by["d"]["startMs"] == pytest.approx(1004.0)
+
+
+def test_skew_correction_single_process_is_identity():
+    spans = [
+        _span("a", None, "root", "X", 100.0, 5.0, "A"),
+        _span("b", "a", "child", "X", 101.0, 2.0, "A"),
+    ]
+    offsets = traceview.correct_skew(spans)
+    assert offsets == {"A": 0.0}
+    assert spans[0]["startMs"] == 100.0
+
+
+def test_render_tree_shape():
+    spans = [
+        _span("a", None, "root", "QueryAPI", 0.0, 10.0, "A"),
+        _span("b", "a", "child1", "QueryAPI", 1.0, 3.0, "A"),
+        _span("c", "b", "grandchild", "Other", 2.0, 1.0, "B"),
+        _span("d", "a", "child2", "QueryAPI", 5.0, 4.0, "A"),
+    ]
+    text = traceview.render_tree("cafe1234", spans, pinned=["slow"])
+    lines = text.splitlines()
+    assert "cafe1234" in lines[0] and "[pinned: slow]" in lines[0]
+    assert "4 span(s)" in lines[0] and "2 target(s)" in lines[0]
+    # tree order: root, child1, grandchild (deeper indent), child2
+    assert [ln.split("ms")[1].strip().split()[0] for ln in lines[1:]] \
+        == ["root", "+-", "+-", "+-"]
+    assert "grandchild" in lines[3]
+    assert lines[3].index("+-") > lines[2].index("+-")   # deeper
+    for ln in lines[1:]:
+        assert "|" in ln and "#" in ln                   # the bar
+
+
+def test_children_sorted_and_roots_detected():
+    spans = [
+        _span("b", "a", "late", "X", 9.0, 1.0, "A"),     # parent absent
+        _span("c", "b", "k2", "X", 5.0, 1.0, "A"),
+        _span("d", "b", "k1", "X", 3.0, 1.0, "A"),
+    ]
+    roots, children = traceview._children_index(spans)
+    assert [r["spanId"] for r in roots] == ["b"]         # orphan = root
+    assert [c["name"] for c in children["b"]] == ["k1", "k2"]
+
+
+# ---------------------------------------------------------------------------
+# tail retention: the slow trace survives main-ring churn
+# ---------------------------------------------------------------------------
+
+def test_tail_retention_keeps_slow_trace_through_churn(monkeypatch):
+    """A constructed slow trace stays resolvable via ?trace_id= after
+    the main ring (PIO_TRACE_BUFFER spans) churns past capacity."""
+    monkeypatch.setenv("PIO_TRACE_TAIL_MS", "1.0")
+    tracing.set_enabled(True)
+    slow_ctx = tracing.new_context()
+    with tracing.activate(slow_ctx):
+        tracing.record_span("slow_op", tracing.current(), 0.050,
+                            service="test")
+    assert tracing.tail_retained() >= 1
+    # churn: far more healthy spans than the main ring holds
+    monkeypatch.setenv("PIO_TRACE_TAIL_MS", "1e9")
+    for k in range(tracing._ring.capacity + 64):
+        with tracing.activate(tracing.new_context()):
+            tracing.record_span("healthy", tracing.current(), 0.0001)
+    # the slow trace's spans are GONE from the main ring...
+    main_only = [s for s in tracing._ring.spans()
+                 if s.trace_id == slow_ctx.trace_id]
+    assert not main_only
+    # ...but the targeted read still resolves it, flagged as pinned
+    snap = tracing.snapshot(trace_id=slow_ctx.trace_id)
+    assert len(snap["traces"]) == 1
+    trace = snap["traces"][0]
+    assert trace["traceId"] == slow_ctx.trace_id
+    assert any(s["name"] == "slow_op" for s in trace["spans"])
+    assert "slow" in trace["pinned"]
+    assert snap["tail"]["retained"] >= 1
+
+
+def test_tail_ring_bounded_oldest_pin_evicted(monkeypatch):
+    monkeypatch.setenv("PIO_TRACE_TAIL_TRACES", "4")
+    tracing.set_enabled(True)
+    ids = []
+    for k in range(8):
+        ctx = tracing.new_context()
+        ids.append(ctx.trace_id)
+        tracing.pin_trace(ctx.trace_id, "slow")
+    assert tracing.tail_retained() == 4
+    for old in ids[:4]:
+        assert not tracing._tail.reasons_for(old)
+    for new in ids[4:]:
+        assert tracing._tail.reasons_for(new)
+
+
+def test_error_response_pins_trace():
+    """A 5xx on a traced request pins the trace at the transport."""
+    class Boom:
+        def handle(self, method, path, query=None, body=b"",
+                   headers=None):
+            raise RuntimeError("kaboom")
+
+    out = dispatch_request(Boom(), "GET", "/explode", b"",
+                           {"x-pio-trace": "feedface00000001-aaaa"})
+    assert out.status == 500
+    assert "error" in tracing._tail.reasons_for("feedface00000001")
+
+
+def test_degraded_response_pins_trace(memory_storage):
+    from journal_test_util import trained_query_api
+    from predictionio_tpu.common import resilience
+
+    api = trained_query_api(memory_storage, batching="off")
+    try:
+        algo = api.algorithms[0]
+        real = type(algo).predict
+
+        def tainted(model, query):
+            resilience.note_degraded("test lookup failure")
+            return real(algo, model, query)
+
+        algo.predict = tainted
+        server, port = serve_background(api)
+        try:
+            tracing.set_enabled(True)
+            req = urllib.request.Request(
+                f"http://localhost:{port}/queries.json",
+                data=json.dumps({"user": "u1", "num": 2}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req) as r:
+                body = json.loads(r.read())
+            assert body.get("degraded") is True
+            reasons = []
+            with tracing._tail._lock:
+                for entry in tracing._tail._traces.values():
+                    reasons.extend(entry["reasons"])
+            assert "degraded" in reasons
+        finally:
+            server.shutdown()
+    finally:
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: one tree from two live daemons
+# ---------------------------------------------------------------------------
+
+class _LookupALS(ALSAlgorithm):
+    """ALS whose batched predict does one live storage lookup, so the
+    trace genuinely crosses into the storage daemon."""
+
+    def predict_batch(self, model, queries):
+        self._serving_storage.get_meta_data_apps().get_all()
+        return super().predict_batch(model, queries)
+
+    def bind_serving(self, ctx) -> None:
+        self._serving_storage = ctx.storage
+
+
+def _lookup_engine():
+    from predictionio_tpu.controller import Engine, FirstServing
+    from predictionio_tpu.models.recommendation.data_source import (
+        DataSource,
+    )
+    from predictionio_tpu.models.recommendation.preparator import Preparator
+    return Engine(data_source_class=DataSource,
+                  preparator_class=Preparator,
+                  algorithm_class_map={"als": _LookupALS},
+                  serving_class=FirstServing)
+
+
+def _two_daemon_fleet():
+    """(query_api, query server, query url, rpc server, rpc url)."""
+    from predictionio_tpu.data.storage.remote import serve_storage
+
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_B_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "B",
+    })
+    engine = _lookup_engine()
+    apps = backing.get_meta_data_apps()
+    app_id = apps.insert(App(0, "FleetApp", None))
+    backing.get_events().init(app_id)
+    import datetime as dt
+    backing.get_events().insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"u{u}",
+              target_entity_type="item", target_entity_id=f"i{i}",
+              properties=DataMap({"rating": float(1 + (u + i) % 5)}),
+              event_time=dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc))
+        for u in range(6) for i in range(5)], app_id)
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName="FleetApp"),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=3, numIterations=2,
+                                       lambda_=0.05, seed=1)),))
+    run_train(WorkflowContext(storage=backing), engine, ep,
+              engine_factory="fleet-test",
+              params_json={
+                  "datasource": {"params": {"appName": "FleetApp"}},
+                  "algorithms": [{"name": "als", "params": {
+                      "rank": 3, "numIterations": 2, "lambda": 0.05,
+                      "seed": 1}}]})
+    rpc_server = serve_storage(backing, host="127.0.0.1", port=0)
+    rpc_port = rpc_server.server_address[1]
+    remote = Storage(env={
+        "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{rpc_port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+    })
+    api = QueryAPI(storage=remote, engine=engine,
+                   config=ServerConfig(batching="on"))
+    server, port = serve_background(api)
+    return (api, server, f"http://localhost:{port}",
+            rpc_server, f"http://127.0.0.1:{rpc_port}")
+
+
+def test_pio_trace_assembles_one_tree_from_two_live_daemons():
+    """THE acceptance e2e: a query->storage request's spans, read back
+    from TWO live daemons over HTTP, join into ONE tree containing
+    both services, and `pio trace` renders it (exit 0)."""
+    api, server, query_url, rpc_server, rpc_url = _two_daemon_fleet()
+    tracing.clear()
+    tracing.set_enabled(True)
+    try:
+        req = urllib.request.Request(
+            f"{query_url}/queries.json",
+            data=json.dumps({"user": "u1", "num": 3}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        # the trace that carried the query (it has a storage RPC span)
+        snap = tracing.snapshot()
+        trace_id = None
+        for trace in snap["traces"]:
+            if any(s["name"] == "server:/rpc" for s in trace["spans"]):
+                trace_id = trace["traceId"]
+                break
+        assert trace_id is not None, snap
+        targets = [query_url, rpc_url]
+        spans, errors, _pinned = traceview.fetch_trace(targets, trace_id)
+        assert not errors
+        traceview.correct_skew(spans)
+        roots, children = traceview._children_index(spans)
+        assert len(roots) == 1, [
+            (s["name"], s["parentId"]) for s in spans]   # ONE tree
+        names = {s["name"] for s in spans}
+        for expected in ("server:/queries.json", "admission",
+                         "dispatch", "storage", "server:/rpc"):
+            assert expected in names, sorted(names)
+        services = {s["service"] for s in spans}
+        assert "StorageRPCAPI" in services       # the storage daemon's
+        assert "query-server" in services or "QueryAPI" in services
+        # the CLI end of it: renders and exits 0
+        buf = io.StringIO()
+        rc = traceview.run_trace(trace_id, targets, out=buf)
+        text = buf.getvalue()
+        assert rc == 0, text
+        assert "server:/queries.json" in text
+        assert "server:/rpc" in text
+        # unknown trace id -> 1
+        buf = io.StringIO()
+        assert traceview.run_trace("0" * 16, targets, out=buf) == 1
+    finally:
+        tracing.set_enabled(None)
+        server.shutdown()
+        api.close()
+        rpc_server.shutdown()
+        rpc_server.server_close()
+
+
+def test_pio_events_merges_and_follows_fleet_journals():
+    api, server, query_url, rpc_server, rpc_url = _two_daemon_fleet()
+    try:
+        journal.clear()
+        journal.emit("breaker", "opened for ep", level=journal.RED,
+                     endpoint="ep")
+        journal.emit("wal", "repaired torn tail", level=journal.WARN)
+        targets = [query_url, rpc_url]
+        buf = io.StringIO()
+        rc = traceview.run_events(targets, level="warn", out=buf)
+        text = buf.getvalue()
+        assert rc == 0
+        assert "breaker" in text and "wal" in text
+        assert "RED" in text and "WARN" in text
+        # incremental: from the last seq, a fresh read is empty...
+        last = journal.snapshot()["lastSeq"]
+        buf = io.StringIO()
+        assert traceview.run_events(targets, since_seq=last,
+                                    out=buf) == 0
+        assert buf.getvalue() == ""
+        # ...and --follow picks up what lands between polls
+        journal.emit("lifecycle", "gen 2 live")
+        buf = io.StringIO()
+        rc = traceview.run_events(targets, since_seq=last, follow=True,
+                                  interval_s=0.01, out=buf, max_polls=2)
+        assert rc == 0 and "gen 2 live" in buf.getvalue()
+    finally:
+        server.shutdown()
+        api.close()
+        rpc_server.shutdown()
+        rpc_server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing + doctor line
+# ---------------------------------------------------------------------------
+
+def test_cli_trace_and_events_exit_codes():
+    # both targets dead -> 2
+    assert cli_main(["trace", "a" * 16,
+                     "--targets", "http://127.0.0.1:9",
+                     "--timeout", "0.3"]) == 2
+    assert cli_main(["events",
+                     "--targets", "http://127.0.0.1:9",
+                     "--timeout", "0.3"]) == 2
+    # --targets is required and must be non-empty
+    assert cli_main(["trace", "a" * 16, "--targets", " "]) == 1
+
+
+def test_doctor_recent_events_line(memory_storage):
+    from predictionio_tpu.data.api import EventAPI
+    from predictionio_tpu.tools import doctor
+
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api)
+    try:
+        journal.clear()
+        buf = io.StringIO()
+        doctor.run_doctor(f"http://localhost:{port}", out=buf)
+        assert "events" in buf.getvalue()
+        assert "no WARN/RED journal events" in buf.getvalue()
+        journal.emit("wal", "repaired torn WAL tail",
+                     level=journal.WARN, path="x")
+        buf = io.StringIO()
+        doctor.run_doctor(f"http://localhost:{port}", out=buf)
+        text = buf.getvalue()
+        assert "repaired torn WAL tail" in text
+        assert "ago)" in text
+        # journal off -> the NA hint, not a crash
+        journal.set_enabled(False)
+        buf = io.StringIO()
+        doctor.run_doctor(f"http://localhost:{port}", out=buf)
+        assert "journal off" in buf.getvalue()
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
