@@ -153,6 +153,35 @@ ENV_VARS: Dict[str, str] = {
     "PIO_SERVE_WARMUP_FLUSHES":
         "flush count that ends the recompile watchdog's warmup when no "
         "explicit AOT-complete mark arrives (default 32)",
+    # ---------------------------------------------------- realtime fold-in
+    "PIO_FOLDIN":
+        "realtime fold-in speed layer: 1/0 overrides `pio deploy "
+        "--foldin off` (0 = off everywhere, every endpoint "
+        "byte-identical to a non-fold-in server — the tier-1 default)",
+    "PIO_FOLDIN_TICK_MS":
+        "fold-in tick cadence in ms when started via the standalone "
+        "runner default paths (ServerConfig/--foldin-tick-ms wins on "
+        "deploys; default 250)",
+    "PIO_FOLDIN_HEADROOM":
+        "user-row capacity pre-padded at model load for fold-in "
+        "appends (default 1024); exhaustion falls back to the /reload "
+        "hot-swap with re-grown capacity",
+    "PIO_FOLDIN_MAX_EVENTS":
+        "per-user history cap for the fold-in solve (most-recent N "
+        "rating events, default 256; also the per-user slot width of "
+        "the padded solve batch — see KNOWN_ISSUES #13)",
+    "PIO_FOLDIN_USER_BUCKETS":
+        "comma-separated dirty-user batch padding buckets for the "
+        "fold-in solve/publication programs (default 1,8,64)",
+    "PIO_FOLDIN_CURSOR_DIR":
+        "directory for the persistent fold-in cursor files (default "
+        "$PIO_FS_BASEDIR/foldin)",
+    "PIO_FOLDIN_DRIFT_EVERY":
+        "ticks between fold-in drift probes — published rows vs a "
+        "fresh half-step on the same events (default 64; 0 disables)",
+    "PIO_FOLDIN_DRIFT_RECALL_MIN":
+        "recall@10 floor below which the fold-in drift probe verdict "
+        "is FAILED (journal WARN + doctor WARN; default 0.99)",
     # --------------------------------------------------------------- AOT
     "PIO_AOT":
         "ahead-of-time serving compilation: 1/0 overrides "
@@ -290,6 +319,23 @@ METRICS: Dict[str, str] = {
     "pio_serve_quant_recall":
         "deploy-time ranking-parity probe of the quantized path vs fp32 "
         "(recall@k / exact-match@1)",
+    # ---------------------------------------------------- realtime fold-in
+    "pio_foldin_freshness_seconds":
+        "event ack to servable factor (the speed-layer latency the "
+        "whole fold-in subsystem exists to bound)",
+    "pio_foldin_cursor_lag_events":
+        "events between the fold-in cursor and the event-log head "
+        "after the latest tick",
+    "pio_foldin_last_tick_seconds":
+        "wall-clock of the most recent fold-in tick (read + solve + "
+        "publish)",
+    "pio_foldin_users_total":
+        "fold-in user outcomes: folded / appended (new user into "
+        "headroom) / pending (deferred to the next tick or reload)",
+    "pio_foldin_ticks_total": "fold-in ticks by outcome (ok/empty/error)",
+    "pio_foldin_drift_recall":
+        "latest drift-probe recall@10: published fold-in rows vs a "
+        "fresh half-step on the same events (KNOWN_ISSUES #13)",
     "pio_degraded_batches_total":
         "flushes tainted by a failed side-channel lookup",
     "pio_degraded_queries_upper_bound":
@@ -381,6 +427,10 @@ JOURNAL_CATEGORIES: Dict[str, str] = {
         "SLO burn-rate threshold crossings: fast-window page edges "
         "(red), slow-window ticket edges (warn), and recoveries "
         "(common/slo.py)",
+    "foldin":
+        "realtime fold-in lifecycle: worker bound to a generation, "
+        "headroom-exhausted /reload fallback, failed ticks, drift-"
+        "probe failures (realtime/foldin.py)",
 }
 
 

@@ -212,6 +212,21 @@ def note_quant(summary: Optional[Dict[str, Any]]) -> None:
         _quant_state = dict(summary) if summary is not None else None
 
 
+#: most recent realtime fold-in state (realtime/foldin.py via
+#: note_foldin); /debug/device.json and `pio doctor`'s foldin line
+#: read it
+_foldin_state: Optional[Dict[str, Any]] = None
+
+
+def note_foldin(summary: Optional[Dict[str, Any]]) -> None:
+    """Record (or with None, clear) the fold-in worker's state (cursor
+    lag, last tick, freshness percentiles, drift verdict) for the
+    debug surface."""
+    global _foldin_state
+    with _lock:
+        _foldin_state = dict(summary) if summary is not None else None
+
+
 def serving_warmup_done() -> bool:
     with _lock:
         return _warmup_done
@@ -506,6 +521,8 @@ def debug_snapshot() -> Dict[str, Any]:
                           if _sharding_state is not None else None)
         quant_state = (dict(_quant_state)
                        if _quant_state is not None else None)
+        foldin_state = (dict(_foldin_state)
+                        if _foldin_state is not None else None)
     watchdog["compilesTotal"] = compiles_total()
     watchdog["postWarmupRecompiles"] = post_warmup_recompiles()
     with CircuitBreaker._registry_lock:
@@ -517,6 +534,7 @@ def debug_snapshot() -> Dict[str, Any]:
         "aot": aot_state,
         "sharding": sharding_state,
         "quant": quant_state,
+        "foldin": foldin_state,
         "devices": _device_stats(),
         "liveArrays": _live_array_stats(),
         "compileCache": {"dir": compile_cache_dir(),

@@ -22,7 +22,9 @@ V = TypeVar("V", bound=Hashable)
 
 
 class BiMap(Generic[K, V]):
-    """Immutable bidirectional map. Raises on non-injective input."""
+    """Bidirectional map. Raises on non-injective input. Effectively
+    immutable except for :meth:`add`, the append-only path the realtime
+    fold-in layer uses to register new users against new factor rows."""
 
     def __init__(self, forward: Dict[K, V]):
         self._fwd: Dict[K, V] = dict(forward)
@@ -47,6 +49,21 @@ class BiMap(Generic[K, V]):
         inv._fwd = self._rev
         inv._rev = self._fwd
         return inv
+
+    def add(self, key: K, value: V) -> None:
+        """Append one NEW pair (realtime fold-in registers a freshly
+        folded user under its assigned factor row). The map stays
+        injective — rebinding an existing key or value raises. Single
+        dict inserts under the GIL, so concurrent ``get``/``inverse``
+        readers (the serving threads) observe either the old or the new
+        map, never a torn one; inverse() views share the same dicts and
+        see the addition immediately."""
+        if key in self._fwd:
+            raise ValueError(f"BiMap key {key!r} is already bound")
+        if value in self._rev:
+            raise ValueError(f"BiMap value {value!r} is already bound")
+        self._fwd[key] = value
+        self._rev[value] = key
 
     def take(self, n: int) -> "BiMap[K, V]":
         return BiMap(dict(list(self._fwd.items())[:n]))

@@ -1356,6 +1356,8 @@ class EventlogEvents(Events):
         tt_code: Optional[int],
         tomb_rows: Optional[List[int]],
         rating_property: str,
+        min_row: int = 0,
+        with_meta: bool = False,
     ) -> Dict[str, np.ndarray]:
         """Decode + filter one immutable chunk into bulk-read columns.
 
@@ -1365,7 +1367,13 @@ class EventlogEvents(Events):
         like the generic object path's float(); the extras offsets come
         from the chunk's cached column dict when the serving LRU already
         holds it (``__extra_offsets__`` is precomputed there) instead of
-        re-running the cumsum over the whole chunk per read."""
+        re-running the cumsum over the whole chunk per read.
+
+        ``min_row`` drops rows before that index (the incremental-read
+        cursor, :meth:`read_columns_since`); ``with_meta`` additionally
+        returns the ``creation_ms`` column (ack time — the fold-in
+        freshness clock starts there) and the surviving ``row`` indices.
+        Defaults preserve the bulk-read output byte for byte."""
         from predictionio_tpu.common import telemetry
         t0 = None
         if telemetry.on():
@@ -1374,6 +1382,8 @@ class EventlogEvents(Events):
         nc = "nc_" + rating_property
         with np.load(sh.chunk_path(seq), allow_pickle=False) as data:
             mask = np.ones(data["event"].shape[0], dtype=bool)
+            if min_row > 0:
+                mask[:min(min_row, mask.shape[0])] = False
             if ev_codes is not None:
                 mask &= np.isin(data["event"], ev_codes)
             if et_code is not None:
@@ -1413,6 +1423,9 @@ class EventlogEvents(Events):
                 "rating": r,
                 "time_ms": data["time_ms"][mask],
             }
+            if with_meta:
+                out["creation_ms"] = data["creation_ms"][mask]
+                out["row"] = np.nonzero(mask)[0].astype(np.int64)
         if t0 is not None:
             import time as _t
             telemetry.registry().histogram(
@@ -1433,11 +1446,20 @@ class EventlogEvents(Events):
         entity_type: Optional[str],
         target_entity_type: Optional[str],
         rating_property: str,
+        start_row: int = 0,
+        with_meta: bool = False,
     ) -> Optional[Dict[str, np.ndarray]]:
         """Encode the unflushed rows (ours or the writer's WAL tail) as one
-        pseudo-chunk; None when nothing matches."""
+        pseudo-chunk; None when nothing matches. ``start_row``/
+        ``with_meta`` serve the incremental cursor read exactly like the
+        chunk decoder's ``min_row`` (defaults keep the bulk path
+        byte-identical)."""
         ent, tgt, evt, rat, tms = [], [], [], [], []
+        cms: List[int] = []
+        rows: List[int] = []
         for row, e in enumerate(buffer):
+            if row < start_row:
+                continue
             eid = f"{token}-{next_seq}-{row}"
             if eid in tombstones:
                 continue
@@ -1453,6 +1475,9 @@ class EventlogEvents(Events):
                        if e.target_entity_id is not None else -1)
             evt.append(codes_get(e.event, -1))
             tms.append(_millis(e.event_time))
+            if with_meta:
+                cms.append(_millis(e.creation_time))
+                rows.append(row)
             v = e.properties.get_opt(rating_property)
             try:
                 rat.append(float(v) if v is not None else np.nan)
@@ -1460,13 +1485,17 @@ class EventlogEvents(Events):
                 rat.append(np.nan)
         if not ent:
             return None
-        return {
+        out = {
             "entity_code": np.asarray(ent, np.int32),
             "target_code": np.asarray(tgt, np.int32),
             "event_code": np.asarray(evt, np.int32),
             "rating": np.asarray(rat, np.float32),
             "time_ms": np.asarray(tms, np.int64),
         }
+        if with_meta:
+            out["creation_ms"] = np.asarray(cms, np.int64)
+            out["row"] = np.asarray(rows, np.int64)
+        return out
 
     def read_columns_streamed(
         self,
@@ -1593,4 +1622,150 @@ class EventlogEvents(Events):
             "event_code": cat("event_code", np.int32),
             "rating": cat("rating", np.float32),
             "time_ms": cat("time_ms", np.int64),
+        }
+
+    # -- incremental cursor read (the realtime fold-in tail) -----------------
+    #
+    # A cursor is {"seq": s, "row": r}: every event at a log position
+    # strictly before (s, r) — all rows of chunks with seq < s, plus the
+    # first r rows of seq s — has been consumed. Positions are STABLE
+    # across compaction: a buffer row's index IS its row in the chunk its
+    # WAL becomes (insert ids are minted from the same numbering), so a
+    # cursor taken against the buffer stays valid after the flush. New
+    # events only ever append at/after the head, never before a cursor.
+    # Crash safety rides the WAL contracts from the ingest path: a row a
+    # reader can observe was acknowledged, acknowledged implies durable
+    # (group commit releases the ack only after the WAL write lands), and
+    # torn unacknowledged tails are dropped by the tailer — so a persisted
+    # cursor replayed after a crash never skips an acknowledged event and
+    # never sees a phantom one.
+
+    def head_cursor(self, app_id: int,
+                    channel_id: Optional[int] = None) -> Dict[str, int]:
+        """The cursor at the CURRENT end of the log: a reader that wants
+        "only events from now on" (a fold-in worker starting against a
+        freshly trained model) starts here."""
+        with self._lock:
+            sh = self._shard(app_id, channel_id)
+            self._refresh(sh)
+            return {"seq": int(sh.next_seq), "row": len(sh.buffer)}
+
+    def cursor_lag(self, app_id: int, channel_id: Optional[int] = None,
+                   cursor: Optional[Dict[str, int]] = None) -> int:
+        """Events at/after ``cursor`` that a :meth:`read_columns_since`
+        would consume — the fold-in worker's lag gauge. O(chunks past
+        the cursor); 0 for a cursor at the head."""
+        cur_seq, cur_row = self._normalize_cursor(cursor)
+        lag = 0
+        with self._lock:
+            sh = self._shard(app_id, channel_id)
+            self._refresh(sh)
+            cur_seq = min(cur_seq, sh.next_seq)
+            for seq in sh.chunk_seqs():
+                if seq < cur_seq:
+                    continue
+                n = int(sh.chunk_data(seq)["event"].shape[0])
+                lag += n - (min(cur_row, n) if seq == cur_seq else 0)
+            tail_from = cur_row if cur_seq == sh.next_seq else 0
+            lag += max(len(sh.buffer) - tail_from, 0)
+        return lag
+
+    @staticmethod
+    def _normalize_cursor(cursor: Optional[Dict[str, int]]
+                          ) -> Tuple[int, int]:
+        if not cursor:
+            return 0, 0
+        return max(int(cursor.get("seq", 0)), 0), \
+            max(int(cursor.get("row", 0)), 0)
+
+    def read_columns_since(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        cursor: Optional[Dict[str, int]] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        rating_property: str = "rating",
+    ) -> Tuple[Dict[str, int], Dict[str, object]]:
+        """Incremental twin of :meth:`read_columns`: only events at/after
+        ``cursor``, plus the advanced cursor. Returns
+        ``(new_cursor, columns)`` where columns carry the bulk-read keys
+        (pool / entity_code / target_code / event_code / rating /
+        time_ms) plus ``creation_ms`` — the ingest ack time, which is
+        where the fold-in freshness clock starts (KNOWN_ISSUES #3 does
+        not apply: these are wall-clock points recorded at ingest, not
+        timed regions).
+
+        The cursor advances over EVERY event in the log window — filters
+        narrow the returned columns, never the consumed range — so a
+        follower's cursor converges on the head regardless of what it
+        filters for. A cursor pointing past the head (the shard was
+        reset/removed externally) is clamped to the head; a cursor from
+        before a compaction replays nothing twice (chunk-over-WAL
+        resolution keeps each row in exactly one place). Serial decode
+        by design: a tick's window is bounded by the tick interval, not
+        the log size, so the bulk read's thread pool would be overhead
+        here."""
+        cur_seq, cur_row = self._normalize_cursor(cursor)
+        with self._lock:
+            sh = self._shard(app_id, channel_id)
+            self._refresh(sh)
+            pool = list(sh.pool)
+            seqs = [s for s in sh.chunk_seqs() if s >= cur_seq]
+            buffer = list(sh.buffer)
+            next_seq = sh.next_seq
+            token = sh.token
+            tombstones = set(sh.tombstones)
+            ev_codes = ([sh.codes[nm] for nm in event_names
+                         if nm in sh.codes]
+                        if event_names is not None else None)
+            et_code = (sh.codes.get(entity_type, -2)
+                       if entity_type is not None else None)
+            tt_code = (sh.codes.get(target_entity_type, -2)
+                       if target_entity_type is not None else None)
+        if cur_seq > next_seq:
+            # the shard was reset under this cursor: clamp to the live
+            # head (the old positions no longer name anything)
+            logger.warning(
+                "eventlog: cursor seq %d is past the live head %d "
+                "(shard reset?); clamping to the head", cur_seq, next_seq)
+            cur_seq, cur_row = next_seq, len(buffer)
+        codes_get = sh.codes.get
+        tomb_by_seq: Dict[int, List[int]] = {}
+        for t in tombstones:
+            try:
+                tok, seq_s, row_s = t.split("-", 2)
+                if tok == token:
+                    tomb_by_seq.setdefault(int(seq_s), []).append(int(row_s))
+            except ValueError:
+                continue
+        parts: List[Dict[str, np.ndarray]] = []
+        for seq in seqs:
+            parts.append(self._decode_chunk_columns(
+                sh, seq, ev_codes, et_code, tt_code,
+                tomb_by_seq.get(seq), rating_property,
+                min_row=cur_row if seq == cur_seq else 0,
+                with_meta=True))
+        tail_from = cur_row if cur_seq == next_seq else 0
+        tail = self._encode_buffer_tail(
+            buffer, codes_get, token, next_seq, tombstones,
+            event_names, entity_type, target_entity_type, rating_property,
+            start_row=tail_from, with_meta=True)
+        if tail is not None:
+            parts.append(tail)
+
+        def cat(key: str, dtype) -> np.ndarray:
+            xs = [p[key] for p in parts]
+            return np.concatenate(xs) if xs else np.empty(0, dtype=dtype)
+
+        new_cursor = {"seq": int(next_seq), "row": len(buffer)}
+        return new_cursor, {
+            "pool": pool,
+            "entity_code": cat("entity_code", np.int32),
+            "target_code": cat("target_code", np.int32),
+            "event_code": cat("event_code", np.int32),
+            "rating": cat("rating", np.float32),
+            "time_ms": cat("time_ms", np.int64),
+            "creation_ms": cat("creation_ms", np.int64),
         }

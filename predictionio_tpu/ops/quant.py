@@ -304,6 +304,25 @@ def topk_for_users_quant(
     return stable_topk(scores, k)
 
 
+@jax.jit
+def scatter_user_rows_quant(
+    u_q: jnp.ndarray,        # (n_users, r) int8, device
+    u_scale: jnp.ndarray,    # (n_users,) fp32, device
+    ixs: jnp.ndarray,        # (b,) int32 rows to replace
+    q_rows: jnp.ndarray,     # (b, r) int8 replacement rows
+    scales: jnp.ndarray,     # (b,) fp32 replacement per-row scales
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold-in publication scatter for the replicated quantized layout:
+    replace the touched user rows AND their per-row scales in one
+    dispatch (realtime/foldin.py re-quantizes exactly the updated rows
+    host-side — per-row symmetric quantization keeps that local and
+    exact). ``ixs`` must be in-bounds (the worker's capacity
+    bookkeeping guarantees it, KNOWN_ISSUES #5) and duplicate indices
+    must carry identical rows. Returns NEW arrays — the caller swaps a
+    rebuilt QuantizedServing in one atomic reference assignment."""
+    return u_q.at[ixs].set(q_rows), u_scale.at[ixs].set(scales)
+
+
 @partial(jax.jit, static_argnames=("k", "n_items"))
 def topk_for_user_quant(
     u_q: jnp.ndarray,        # (n_users, r) int8
@@ -399,6 +418,19 @@ class QuantizedServing:
         return topk_for_user_quant(
             self.u_q, self.u_scale, self.vt_q, self.v_scale,
             jnp.int32(user_ix), k=int(k), n_items=self.n_items)
+
+    def apply_user_rows(self, ixs, rows_fp32) -> "QuantizedServing":
+        """A NEW QuantizedServing with ``rows_fp32`` re-quantized
+        per-row and scattered into the user matrix at ``ixs`` (the item
+        layout is untouched — fold-in's fixed-item-matrix contract).
+        The caller publishes by swapping its model's ``quant``
+        reference: one atomic assignment, so every in-flight query
+        reads a consistent (rows, scales) pair."""
+        ixs = np.asarray(ixs, dtype=np.int32)
+        q_rows, scales = quantize_rows(np.asarray(rows_fp32, np.float32))
+        new_q, new_s = scatter_user_rows_quant(
+            self.u_q, self.u_scale, ixs, q_rows, scales)
+        return dataclasses.replace(self, u_q=new_q, u_scale=new_s)
 
     def int8_bytes(self) -> int:
         """Logical serving footprint (int8 matrices + fp32 scales; same
@@ -511,6 +543,36 @@ def _quant_user_primer(qs: QuantizedServing, k: int):
     return prime
 
 
+def scatter_program_specs(qs: QuantizedServing,
+                          buckets: Iterable[int]) -> List[Any]:
+    """One ProgramSpec per fold-in publication bucket for the
+    replicated int8 layout (the row+scale scatter the realtime layer
+    dispatches per tick); prebuilt with the serving programs so
+    fold-in publication never compiles post-warmup."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    out: List[Any] = []
+    for b in sorted({int(x) for x in buckets}):
+        out.append(ProgramSpec(
+            name="scatter_user_rows_quant",
+            key=("scatter_user_rows_quant", qs.n_users, qs.rank, int(b)),
+            prime=_scatter_primer(qs, int(b))))
+    return out
+
+
+def _scatter_primer(qs: QuantizedServing, bucket: int):
+    def prime():
+        # no-op shaped update (results discarded): zero rows quantize
+        # to zeros with scale 1.0; device_get ends the dispatch in a
+        # real host transfer (KNOWN_ISSUES #3)
+        ix = np.zeros((bucket,), dtype=np.int32)
+        q_rows, scales = quantize_rows(
+            np.zeros((bucket, qs.rank), dtype=np.float32))
+        jax.device_get(scatter_user_rows_quant(
+            qs.u_q, qs.u_scale, ix, q_rows, scales)[1][:1])
+    return prime
+
+
 # ---------------------------------------------------------------------------
 # deploy-state surface: GET / "quant" section, gauges, /debug/device.json
 # ---------------------------------------------------------------------------
@@ -588,6 +650,12 @@ def _register() -> None:
         "topk_for_user_quant", topk_for_user_quant, kind="serving",
         note="enumerated per k by quant_program_specs (inline / "
              "batching-off quantized path)")
+    aot.register_jit(
+        "scatter_user_rows_quant", scatter_user_rows_quant,
+        kind="serving",
+        note="fold-in publication scatter for the replicated int8 "
+             "layout (realtime/foldin.py); enumerated per publication "
+             "bucket by scatter_program_specs on fold-in deploys")
 
 
 _register()

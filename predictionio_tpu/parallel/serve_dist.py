@@ -288,6 +288,60 @@ def topk_for_users_sharded_quant(
 
 
 # ---------------------------------------------------------------------------
+# realtime fold-in publication: scatter updated user rows into the live
+# row-sharded layout (predictionio_tpu/realtime/foldin.py drives these)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("mesh",))
+def scatter_user_rows_sharded(
+    user_shards: jnp.ndarray,    # (n_dev * rows_dev_u, r) fp32, sharded
+    ixs: jnp.ndarray,            # (b,) int32 global row ids, replicated
+    rows: jnp.ndarray,           # (b, r) fp32 replacement rows, replicated
+    *,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """One-dispatch row scatter into the sharded user matrix: each
+    device applies exactly the updates that land in its contiguous row
+    block (the replicated update set is tiny — a fold-in tick's dirty
+    users — so shipping it everywhere costs less than any routing
+    protocol would). ``ixs`` must be in-bounds of the padded row space;
+    the fold-in worker resolves them against the model's vocabulary +
+    headroom bookkeeping first (KNOWN_ISSUES #5). Duplicate indices
+    must carry identical rows (the worker dedups per tick). Returns a
+    NEW sharded array — publication is the caller's atomic reference
+    swap, so in-flight queries keep reading the old layout."""
+    out = user_shards.at[ixs].set(rows)
+    return lax.with_sharding_constraint(
+        out, NamedSharding(mesh, P(mesh.axis_names[0], None)))
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def scatter_user_rows_sharded_quant(
+    user_shards: jnp.ndarray,    # (n_dev * rows_dev_u, r) int8, sharded
+    user_scales: jnp.ndarray,    # (n_dev * rows_dev_u,) fp32, sharded
+    ixs: jnp.ndarray,            # (b,) int32 global row ids, replicated
+    q_rows: jnp.ndarray,         # (b, r) int8 quantized rows, replicated
+    scales: jnp.ndarray,         # (b,) fp32 per-row scales, replicated
+    *,
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The int8 twin: per-row symmetric quantization makes re-quantizing
+    exactly the touched rows local and exact (ops/quant.py quantize_rows
+    runs host-side on the new rows; nothing else re-quantizes), so the
+    published int8 rows + scales are bit-identical to what a full
+    re-quantization of the updated matrix would produce for those rows.
+    Same in-bounds/dedup contract as the fp32 scatter."""
+    axis = mesh.axis_names[0]
+    out_q = lax.with_sharding_constraint(
+        user_shards.at[ixs].set(q_rows),
+        NamedSharding(mesh, P(axis, None)))
+    out_s = lax.with_sharding_constraint(
+        user_scales.at[ixs].set(scales),
+        NamedSharding(mesh, P(axis)))
+    return out_q, out_s
+
+
+# ---------------------------------------------------------------------------
 # layout: canonical factors -> row-sharded device arrays
 # ---------------------------------------------------------------------------
 
@@ -363,6 +417,35 @@ class ShardedFactors:
             k=int(k), n_items=self.n_items,
             rows_dev_u=self.rows_dev_u, rows_dev_i=self.rows_dev_i,
             mesh=self.mesh)
+
+    @property
+    def user_capacity(self) -> int:
+        """Padded user-row capacity (rows_dev_u * n_dev): the headroom
+        the realtime fold-in layer appends new users into."""
+        return int(self.rows_dev_u) * self.n_shards
+
+    def apply_user_rows(self, ixs, rows_fp32) -> "ShardedFactors":
+        """A NEW ShardedFactors with ``rows_fp32`` scattered into the
+        user matrix at global rows ``ixs`` (item shards unchanged — the
+        fold-in contract is a fixed item matrix). fp32 layouts scatter
+        the rows directly; int8 layouts re-quantize exactly the touched
+        rows (per-row scales keep it local and exact) and scatter rows
+        + scales in one dispatch. The caller publishes by swapping its
+        model's ``sharding`` reference to the returned object — one
+        atomic Python assignment, zero dropped queries."""
+        ixs = np.asarray(ixs, dtype=np.int32)
+        rows = np.asarray(rows_fp32, dtype=np.float32)
+        if self.dtype == "int8":
+            from predictionio_tpu.ops.quant import quantize_rows
+            q_rows, scales = quantize_rows(rows)
+            new_q, new_s = scatter_user_rows_sharded_quant(
+                self.user_shards, self.user_scales, ixs, q_rows, scales,
+                mesh=self.mesh)
+            return dataclasses.replace(
+                self, user_shards=new_q, user_scales=new_s)
+        new_u = scatter_user_rows_sharded(
+            self.user_shards, ixs, rows, mesh=self.mesh)
+        return dataclasses.replace(self, user_shards=new_u)
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -536,6 +619,49 @@ def _sharded_primer(sharded: ShardedFactors, bucket: int, k: int):
     return prime
 
 
+def scatter_program_specs(sharded: ShardedFactors,
+                          buckets: Iterable[int]) -> List[Any]:
+    """One ProgramSpec per fold-in publication bucket: the row-scatter
+    program the realtime layer dispatches every tick. Prebuilt with the
+    serving programs so the first fold-in publication after /readyz
+    compiles nothing (post-warmup recompiles stay 0 with fold-in on)."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    name = ("scatter_user_rows_sharded_quant" if sharded.dtype == "int8"
+            else "scatter_user_rows_sharded")
+    out: List[Any] = []
+    for b in sorted({int(x) for x in buckets}):
+        out.append(ProgramSpec(
+            name=name,
+            key=(name, sharded.n_users, sharded.rank,
+                 sharded.n_shards, int(b)),
+            prime=_scatter_primer(sharded, int(b))))
+    return out
+
+
+def _scatter_primer(sharded: ShardedFactors, bucket: int):
+    def prime():
+        # a no-op update of row 0 onto itself: same program, same
+        # shapes, harmless content. int8 layouts prime the quantized
+        # scatter through apply_user_rows (zero rows quantize to zeros
+        # with scale 1.0 — row 0 is headroom-or-real either way, and
+        # the result is discarded after the transfer below)
+        ix = np.zeros((bucket,), dtype=np.int32)
+        if sharded.dtype == "int8":
+            rows = np.zeros((bucket, sharded.rank), dtype=np.float32)
+            from predictionio_tpu.ops.quant import quantize_rows
+            q_rows, scales = quantize_rows(rows)
+            jax.device_get(scatter_user_rows_sharded_quant(
+                sharded.user_shards, sharded.user_scales, ix, q_rows,
+                scales, mesh=sharded.mesh)[1][:1])
+        else:
+            rows = jax.device_get(sharded.user_shards[:1])
+            rows = np.broadcast_to(rows, (bucket, sharded.rank)).copy()
+            jax.device_get(scatter_user_rows_sharded(
+                sharded.user_shards, ix, rows, mesh=sharded.mesh)[:1])
+    return prime
+
+
 # ---------------------------------------------------------------------------
 # AOT registry entry (the tier-1 lint in tests/test_aot.py checks every
 # @jax.jit def in this module against the registry)
@@ -556,6 +682,18 @@ def _register() -> None:
              "the sharded layout carries int8 factors (ops/quant.py); "
              "mesh-topology-specific like its fp32 sibling, deploy-side "
              "prebuild owns it")
+    aot.register_jit(
+        "scatter_user_rows_sharded", scatter_user_rows_sharded,
+        kind="serving",
+        note="fold-in publication scatter (realtime/foldin.py); "
+             "enumerated per publication bucket by scatter_program_specs "
+             "when the deploy runs with fold-in on a sharded layout")
+    aot.register_jit(
+        "scatter_user_rows_sharded_quant", scatter_user_rows_sharded_quant,
+        kind="serving",
+        note="int8 fold-in publication scatter (rows re-quantized "
+             "per-row host-side); enumerated per publication bucket by "
+             "scatter_program_specs on int8 sharded fold-in deploys")
 
 
 _register()

@@ -1,0 +1,12 @@
+"""Realtime (speed) layer: streaming fold-in of events into servable
+factors — the half of the Lambda architecture the batch trainer isn't.
+
+``realtime.foldin`` tails the event store through a persistent cursor,
+re-solves dirty users' factor rows against the fixed item matrix with
+the training ALS half-step, and publishes the rows atomically into the
+LIVE serving model (replicated, sharded, and quantized layouts alike) —
+a user who signed up seconds ago gets personalized top-k without a
+retrain, a restart, or a dropped query.
+"""
+
+from predictionio_tpu.realtime import foldin  # noqa: F401

@@ -1,0 +1,1233 @@
+"""Streaming ALS fold-in: events become servable factors in seconds.
+
+PredictionIO is a Lambda architecture (PAPER.md §0), but the batch half
+alone leaves a hole the flagship e-commerce scenario falls straight
+into: a user who signed up ten seconds ago has events in the store and
+nothing in the model until the next full ``pio train``. This module is
+the speed layer that closes it:
+
+- **Tail.** A worker follows the event store through a persistent,
+  crash-safe cursor (``eventlog.read_columns_since`` — the incremental
+  twin of the bulk columnar read, riding the WAL ack⇒durable and
+  torn-tail contracts; the memory backend exposes the object-shaped
+  ``read_events_since`` twin). The cursor and the fold-in bookkeeping
+  persist atomically per tick, so a crashed worker resumes without
+  skipping an acknowledged event or double-counting one.
+
+- **Solve.** Solving one user's factors against a FIXED item matrix is
+  a single regularized least-squares solve — exactly the training ALS
+  half-step applied to one row. :func:`foldin_solve` reuses
+  ``ops.als.gram_rhs`` + ``ops.als.solve_factors`` (same presence
+  weights, same ``lambda * count`` regularization), batched over the
+  tick's dirty users and padded onto declared user-bucket shapes so the
+  jit program compiles once per bucket. Every program is AOT-registered
+  and prebuilt before ``/readyz`` flips ready: post-warmup recompiles
+  stay 0 with fold-in on. Each dirty user is re-solved from their FULL
+  (capped) event history, so a folded row equals a fresh half-step from
+  scratch on the same rows — which is what the drift probe checks.
+
+- **Publish.** The hard part. Updated rows land in the LIVE serving
+  model with zero dropped queries, composing with every layout:
+  replicated host numpy (in-place row writes; small-array numpy ops
+  hold the GIL, so a concurrent gather sees whole rows), replicated
+  device fp32 (functional scatter + one atomic reference swap),
+  row-sharded (``serve_dist.scatter_user_rows_sharded`` routes each row
+  to its owning shard; the new ``ShardedFactors`` swaps in as one
+  reference), and int8 quantized (per-row scales make re-quantizing
+  exactly the touched rows local and exact; the rebuilt
+  ``QuantizedServing``/sharded layout swaps in as one reference). New
+  users append into padded capacity headroom pre-allocated at deploy
+  (``PIO_FOLDIN_HEADROOM``) — shapes never change, so no program ever
+  recompiles; when headroom runs out the worker falls back to the
+  generation-coherent ``/reload`` hot-swap and re-folds its pending
+  users into the fresh headroom.
+
+- **Instrument.** ``pio_foldin_freshness_seconds`` (event ack →
+  servable factor), cursor-lag gauge, per-tick latency, a ``foldin``
+  journal category, and a periodic drift probe (published row vs a
+  fresh half-step on the same rows, ranking-parity style per
+  KNOWN_ISSUES #12/#13) surfaced on ``GET /``, ``/debug/device.json``
+  and the `pio doctor` fold-in line.
+
+``PIO_FOLDIN=0`` (the default; ``pio deploy --foldin`` or
+``PIO_FOLDIN=1`` opts in) keeps every existing endpoint byte-identical
+— asserted by test, the same wire-parity contract as PIO_AOT/SERVE_*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.common import devicewatch, journal, telemetry
+from predictionio_tpu.ops import als
+
+logger = logging.getLogger("predictionio_tpu.foldin")
+
+#: buy events carry no rating property; the recommendation template maps
+#: them to 4.0 (DataSource.scala:57-59) — fold-in must agree with train
+_BUY_RATING = 4.0
+
+#: freshness histogram buckets (seconds, event ack -> servable factor)
+_FRESHNESS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+                      30.0, 60.0, 300.0)
+
+
+def _wall_now() -> float:
+    # wall clock for freshness (ack timestamps are wall) and the state
+    # surface's "lastTickAt"; durations use perf_counter (KNOWN_ISSUES
+    # #3 concerns timed regions — those end in a host transfer below)
+    return _dt.datetime.now(_dt.timezone.utc).timestamp()
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + knobs
+# ---------------------------------------------------------------------------
+
+def enabled(mode: str = "off") -> bool:
+    """Is fold-in on for this deploy? ``PIO_FOLDIN`` overrides the
+    ServerConfig mode (0 = off everywhere — the wire-parity escape
+    hatch and the tier-1 default; 1 = on even for ``foldin="off"``)."""
+    env = os.environ.get("PIO_FOLDIN", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    m = (mode or "off").lower()
+    if m not in ("on", "off"):
+        raise ValueError(f"foldin mode must be on/off, got {mode!r}")
+    return m == "on"
+
+
+def default_tick_ms() -> float:
+    """Tick cadence when the deploy/runner does not pin one
+    (``PIO_FOLDIN_TICK_MS``, default 250 ms)."""
+    raw = os.environ.get("PIO_FOLDIN_TICK_MS", "")
+    try:
+        return max(float(raw), 1.0) if raw else 250.0
+    except ValueError:
+        return 250.0
+
+
+def user_buckets() -> Tuple[int, ...]:
+    """Dirty-user batch padding buckets (``PIO_FOLDIN_USER_BUCKETS``,
+    default ``1,8,64``): each tick's solve pads onto the smallest
+    bucket that fits, so the kernel compiles once per bucket — the
+    serving-bucket discipline applied to the fold-in path."""
+    raw = os.environ.get("PIO_FOLDIN_USER_BUCKETS", "1,8,64")
+    out = []
+    for tok in raw.split(","):
+        tok = tok.strip()
+        try:
+            b = int(tok)
+        except ValueError:
+            continue
+        if b >= 1:
+            out.append(b)
+    return tuple(sorted(set(out))) or (1, 8, 64)
+
+
+def max_events_per_user() -> int:
+    """Per-user history cap (``PIO_FOLDIN_MAX_EVENTS``, default 256):
+    the solve reads the user's most-recent N rating events. Also the
+    per-user slot width of the padded solve batch, so it is a jit
+    static — see KNOWN_ISSUES #13 for the (bounded) drift a capped
+    heavy user can show vs the uncapped batch trainer."""
+    raw = os.environ.get("PIO_FOLDIN_MAX_EVENTS", "")
+    try:
+        return max(int(raw), 1) if raw else 256
+    except ValueError:
+        return 256
+
+
+def default_headroom() -> int:
+    raw = os.environ.get("PIO_FOLDIN_HEADROOM", "")
+    try:
+        return max(int(raw), 0) if raw else 1024
+    except ValueError:
+        return 1024
+
+
+def drift_every() -> int:
+    """Ticks between drift probes (``PIO_FOLDIN_DRIFT_EVERY``, default
+    64; 0 disables the probe)."""
+    raw = os.environ.get("PIO_FOLDIN_DRIFT_EVERY", "")
+    try:
+        return max(int(raw), 0) if raw else 64
+    except ValueError:
+        return 64
+
+
+def drift_recall_floor() -> float:
+    """recall@k below which the drift probe's verdict is FAILED
+    (``PIO_FOLDIN_DRIFT_RECALL_MIN``, default 0.99 — the KNOWN_ISSUES
+    #12/#13 ranking-parity posture)."""
+    try:
+        return float(os.environ.get("PIO_FOLDIN_DRIFT_RECALL_MIN", "0.99"))
+    except ValueError:
+        return 0.99
+
+
+def cursor_dir() -> str:
+    d = os.environ.get("PIO_FOLDIN_CURSOR_DIR", "")
+    if d:
+        return d
+    basedir = os.path.expanduser(
+        os.environ.get("PIO_FS_BASEDIR", "~/.pio_store"))
+    return os.path.join(basedir, "foldin")
+
+
+@dataclasses.dataclass
+class FoldinConfig:
+    """One worker's wiring: which app to tail, how the recommendation
+    template maps events to ratings (mirroring its DataSource so the
+    fold-in solve sees exactly the rows a retrain would), and the tick
+    cadence. Built by :func:`config_for` from the deployed engine's
+    params + ServerConfig."""
+    app_name: str
+    channel_id: Optional[int] = None
+    tick_ms: float = 250.0
+    headroom: int = 1024
+    event_names: Tuple[str, ...] = ("rate", "buy")
+    entity_type: str = "user"
+    target_entity_type: str = "item"
+    rating_property: str = "rating"
+    buy_rating: float = _BUY_RATING
+    lambda_: float = 0.01
+    reg_scaling: str = "count"
+    #: cursor-file namespace: the in-process deploy worker and the
+    #: standalone `pio foldin` soak tool must not share a cursor
+    namespace: str = "deploy"
+
+
+def config_for(engine_params: Any, tick_ms: float = 0.0,
+               headroom: Optional[int] = None) -> Optional[FoldinConfig]:
+    """Derive the worker config from a deployed engine's params: the
+    app name from the datasource params, lambda from the first
+    algorithm's params, tick cadence from the caller (0 =
+    ``PIO_FOLDIN_TICK_MS`` or 250 ms). None when the engine is not
+    fold-in-shaped (no appName — e.g. a literal-datasource test
+    engine)."""
+    dsp = getattr(engine_params, "data_source_params", None)
+    app_name = getattr(dsp, "appName", None)
+    if not app_name:
+        return None
+    lam = 0.01
+    for _name, ap in getattr(engine_params, "algorithm_params_list", ()):
+        got = getattr(ap, "lambda_", None)
+        if got is not None:
+            lam = float(got)
+            break
+    return FoldinConfig(
+        app_name=str(app_name),
+        tick_ms=float(tick_ms) if tick_ms else default_tick_ms(),
+        headroom=default_headroom() if headroom is None else int(headroom),
+        lambda_=lam)
+
+
+# ---------------------------------------------------------------------------
+# the solve kernel — the training half-step applied to the tick's users
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_self", "chunk", "reg_scaling"))
+def foldin_solve(
+    item_rows: jnp.ndarray,   # (nnz_pad, r) fp32 gathered item factors
+    self_idx: jnp.ndarray,    # (nnz_pad,) int32 NONDECREASING batch-local
+    rating: jnp.ndarray,      # (nnz_pad,) fp32 (0 in padding slots)
+    counts: jnp.ndarray,      # (n_self,) int32 ratings per batch user
+    lambda_,                  # () fp32 regularization (traced)
+    *,
+    n_self: int,
+    chunk: int,
+    reg_scaling: str = "count",
+) -> jnp.ndarray:
+    """One tick's fold-in: the explicit-ALS half-step on a padded batch
+    of dirty users — bit-for-bit the training math (``gram_rhs`` with
+    presence weights + ``solve_factors`` with the ALS-WR
+    ``lambda * count`` regularization), so a folded row IS a fresh
+    half-step on the same rows.
+
+    The item factors arrive pre-gathered as ``item_rows`` (the worker
+    gathers host-side from its fp32 item-matrix copy), which keeps the
+    program's shapes model-size-independent — (bucket × max-events)
+    only — and keeps quantized deploys free of any device-resident fp32
+    item matrix. ``self_idx`` must be nondecreasing with padding slots
+    at ``n_self`` (the gram_rhs sorted-segment precondition); the
+    worker lays users out contiguously in batch order. The identity
+    ``other_idx`` gather below is trivially in-bounds (arange over the
+    row count; KNOWN_ISSUES #5)."""
+    nnz = item_rows.shape[0]
+    other_idx = jnp.arange(nnz, dtype=jnp.int32)
+    present = (self_idx < n_self).astype(jnp.float32)
+    A, b = als.gram_rhs(item_rows, self_idx, other_idx, present, rating,
+                        n_self, chunk)
+    reg = als._reg_vec(counts, n_self, lambda_, reg_scaling)
+    return als.solve_factors(A, b, reg)
+
+
+@jax.jit
+def scatter_user_rows(
+    U: jnp.ndarray,           # (n_users_pad, r) fp32, device
+    ixs: jnp.ndarray,         # (b,) int32 rows to replace
+    rows: jnp.ndarray,        # (b, r) fp32 replacement rows
+) -> jnp.ndarray:
+    """Fold-in publication scatter for the replicated device-fp32
+    layout. ``ixs`` must be in-bounds of the padded capacity (the
+    worker's bookkeeping guarantees it, KNOWN_ISSUES #5); duplicate
+    indices carry identical rows. Returns a NEW array — publication is
+    the caller's atomic reference swap."""
+    return U.at[ixs].set(rows)
+
+
+# ---------------------------------------------------------------------------
+# AOT enumeration (serving/aot.py prebuilds these before /readyz)
+# ---------------------------------------------------------------------------
+
+def solve_program_specs(rank: int,
+                        reg_scaling: str = "count") -> List[Any]:
+    """One ProgramSpec per user bucket for :func:`foldin_solve`; primed
+    with zero-content arrays of exactly the tick shapes so the first
+    real tick after /readyz compiles nothing."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    me = max_events_per_user()
+    out: List[Any] = []
+    for b in user_buckets():
+        nnz_pad = b * me
+        out.append(ProgramSpec(
+            name="foldin_solve",
+            key=("foldin_solve", int(rank), int(b), nnz_pad, reg_scaling),
+            prime=_solve_primer(int(rank), int(b), nnz_pad, reg_scaling)))
+    return out
+
+
+def _solve_primer(rank: int, bucket: int, nnz_pad: int, reg_scaling: str):
+    def prime():
+        # all-padding batch (self_idx == n_self everywhere): zero Gram
+        # + the reg floor solves to zero rows; device_get ends the
+        # dispatch in a real host transfer (KNOWN_ISSUES #3)
+        jax.device_get(foldin_solve(
+            np.zeros((nnz_pad, rank), np.float32),
+            np.full((nnz_pad,), bucket, np.int32),
+            np.zeros((nnz_pad,), np.float32),
+            np.zeros((bucket,), np.int32),
+            np.float32(0.01), n_self=bucket, chunk=nnz_pad,
+            reg_scaling=reg_scaling))
+    return prime
+
+
+def publication_program_specs(model: Any) -> List[Any]:
+    """The layout-appropriate publication scatter programs for this
+    prepared model, one per user bucket: sharded layouts enumerate
+    through serve_dist, replicated int8 through ops.quant, replicated
+    device fp32 here; host-numpy serving publishes with plain row
+    writes and contributes nothing."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    sharding = getattr(model, "sharding", None)
+    if sharding is not None:
+        from predictionio_tpu.parallel import serve_dist
+        return serve_dist.scatter_program_specs(sharding, user_buckets())
+    quant = getattr(model, "quant", None)
+    if quant is not None:
+        from predictionio_tpu.ops import quant as quant_mod
+        return quant_mod.scatter_program_specs(quant, user_buckets())
+    U = getattr(model, "user_factors", None)
+    if U is None or isinstance(U, np.ndarray):
+        return []
+    n_pad, rank = (int(d) for d in np.shape(U))
+    out: List[Any] = []
+    for b in user_buckets():
+        out.append(ProgramSpec(
+            name="scatter_user_rows",
+            key=("scatter_user_rows", n_pad, rank, int(b)),
+            prime=_scatter_primer(model, int(b))))
+    return out
+
+
+def _scatter_primer(model: Any, bucket: int):
+    def prime():
+        U = model.user_factors
+        rank = int(np.shape(U)[1])
+        ix = np.zeros((bucket,), dtype=np.int32)
+        rows = jax.device_get(U[:1])
+        rows = np.broadcast_to(rows, (bucket, rank)).copy()
+        # functional update, result discarded: same program, no state
+        jax.device_get(scatter_user_rows(U, ix, rows)[:1])
+    return prime
+
+
+def program_specs(models: Sequence[Any], prep: Optional[Dict[str, Any]]
+                  ) -> List[Any]:
+    """Everything the fold-in worker will dispatch, for the deploy's
+    AOT prebuild: the per-bucket solve programs + the publication
+    scatter for the resolved serving layout."""
+    if prep is None:
+        return []
+    model = models[prep["index"]]
+    rank = int(prep["item_factors"].shape[1])
+    return (solve_program_specs(rank, prep.get("reg_scaling", "count"))
+            + publication_program_specs(model))
+
+
+# ---------------------------------------------------------------------------
+# capacity headroom (runs BEFORE prepare_serving, so every layout and
+# every AOT shape already includes the appendable rows)
+# ---------------------------------------------------------------------------
+
+def pad_capacity(models: Sequence[Any], headroom: int,
+                 algorithms: Sequence[Any] = ()) -> Optional[Dict[str, Any]]:
+    """Append ``headroom`` zero rows to the first ALS-shaped model's
+    user-factor matrix — the capacity new users fold into without a
+    shape change (a resize would recompile every serving program; the
+    pad keeps post-warmup recompiles at 0). Returns the prep record the
+    worker binds against: the model index, a host fp32 copy of the
+    item matrix (the solve's gather source — kept host-side so int8
+    deploys stay free of fp32 device copies), and the trained row
+    count. None when no model is fold-in-shaped. Zero pad rows are
+    harmless everywhere downstream: they score 0, are never indexed
+    until a fold registers the user, and quantize to zeros/scale 1."""
+    for i, model in enumerate(models):
+        U = getattr(model, "user_factors", None)
+        V = getattr(model, "item_factors", None)
+        uv = getattr(model, "user_vocab", None)
+        iv = getattr(model, "item_vocab", None)
+        if U is None or V is None or uv is None or iv is None:
+            continue
+        if len(np.shape(U)) != 2:
+            continue
+        U_host = np.asarray(jax.device_get(U), dtype=np.float32)
+        V_host = np.asarray(jax.device_get(V), dtype=np.float32)
+        trained = int(U_host.shape[0])
+        padded = np.zeros((trained + max(int(headroom), 0),
+                           U_host.shape[1]), dtype=np.float32)
+        padded[:trained] = U_host
+        model.user_factors = padded
+        reg_scaling = "count"
+        lam = None
+        if i < len(algorithms):
+            lam = getattr(getattr(algorithms[i], "ap", None),
+                          "lambda_", None)
+        return {
+            "index": i,
+            "item_factors": V_host,
+            "trained_users": trained,
+            "headroom": max(int(headroom), 0),
+            "reg_scaling": reg_scaling,
+            "lambda_": float(lam) if lam is not None else None,
+        }
+    return None
+
+
+# ---------------------------------------------------------------------------
+# event-store tails (feature-detected incremental read surfaces)
+# ---------------------------------------------------------------------------
+
+class _ColumnarTail:
+    """Cursor tail over eventlog's ``read_columns_since``."""
+
+    kind = "columnar"
+
+    def __init__(self, events: Any, app_id: int, cfg: FoldinConfig):
+        self._events = events
+        self._app_id = app_id
+        self._cfg = cfg
+
+    def head(self):
+        return self._events.head_cursor(self._app_id, self._cfg.channel_id)
+
+    def lag(self, cursor) -> int:
+        return int(self._events.cursor_lag(
+            self._app_id, self._cfg.channel_id, cursor))
+
+    def read(self, cursor):
+        cfg = self._cfg
+        new_cursor, cols = self._events.read_columns_since(
+            self._app_id, cfg.channel_id, cursor,
+            event_names=list(cfg.event_names),
+            entity_type=cfg.entity_type,
+            target_entity_type=cfg.target_entity_type,
+            rating_property=cfg.rating_property)
+        pool = cols["pool"]
+        out = []
+        for ent, tgt, evc, rat, cms in zip(
+                cols["entity_code"].tolist(),
+                cols["target_code"].tolist(),
+                cols["event_code"].tolist(),
+                cols["rating"].tolist(),
+                cols["creation_ms"].tolist()):
+            if ent < 0 or tgt < 0 or evc < 0:
+                continue
+            out.append((pool[ent], pool[tgt], pool[evc], rat, cms / 1e3))
+        return new_cursor, out
+
+
+class _ObjectTail:
+    """Cursor tail over the object-shaped ``read_events_since`` (memory
+    backend and anything else without a columnar layout)."""
+
+    kind = "object"
+
+    def __init__(self, events: Any, app_id: int, cfg: FoldinConfig):
+        self._events = events
+        self._app_id = app_id
+        self._cfg = cfg
+
+    def head(self):
+        return self._events.head_cursor(self._app_id, self._cfg.channel_id)
+
+    def lag(self, cursor) -> int:
+        return int(self._events.cursor_lag(
+            self._app_id, self._cfg.channel_id, cursor))
+
+    def read(self, cursor):
+        cfg = self._cfg
+        new_cursor, evs = self._events.read_events_since(
+            self._app_id, cfg.channel_id, cursor)
+        out = []
+        names = set(cfg.event_names)
+        for e in evs:
+            if e.event not in names or e.entity_type != cfg.entity_type:
+                continue
+            if (e.target_entity_type != cfg.target_entity_type
+                    or e.target_entity_id is None):
+                continue
+            v = e.properties.get_opt(cfg.rating_property) \
+                if e.properties else None
+            try:
+                rat = float(v) if v is not None else float("nan")
+            except (TypeError, ValueError):
+                rat = float("nan")
+            out.append((e.entity_id, e.target_entity_id, e.event, rat,
+                        e.creation_time.timestamp()))
+        return new_cursor, out
+
+
+def tail_for(events: Any, app_id: int,
+             cfg: FoldinConfig) -> Optional[Any]:
+    """The incremental tail for this backend, or None when it exposes
+    neither surface (sqlite/remote today — the fold-in matrix in the
+    README says so; the worker then refuses to start with a journal
+    WARN instead of silently polling)."""
+    if hasattr(events, "read_columns_since"):
+        return _ColumnarTail(events, app_id, cfg)
+    if hasattr(events, "read_events_since"):
+        return _ObjectTail(events, app_id, cfg)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# cursor persistence (crash-safe resume)
+# ---------------------------------------------------------------------------
+
+class CursorStore:
+    """Atomic (tmp + rename) JSON persistence of the worker's cursor
+    AND its fold bookkeeping. The save happens after a tick's users are
+    folded, so a crash between read and save replays the window — and
+    replay is idempotent because every fold re-solves from the user's
+    full history. ``folded`` users persist too: a restarted deploy
+    re-loads the TRAINED model, so everything folded since training
+    must fold again into the fresh headroom."""
+
+    def __init__(self, app_id: int, channel_id: Optional[int],
+                 namespace: str, directory: Optional[str] = None):
+        d = directory or cursor_dir()
+        os.makedirs(d, exist_ok=True)
+        chan = f"_{channel_id}" if channel_id else ""
+        self.path = os.path.join(
+            d, f"app_{app_id}{chan}.{namespace}.json")
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (ValueError, OSError):
+            logger.warning("foldin: unreadable cursor file %s; starting "
+                           "from the live head", self.path)
+            return None
+
+    def save(self, cursor: Any, folded: Sequence[str],
+             pending: Sequence[str]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"cursor": cursor, "folded": sorted(folded),
+                       "pending": sorted(pending)}, f)
+        os.replace(tmp, self.path)
+
+
+# ---------------------------------------------------------------------------
+# the worker
+# ---------------------------------------------------------------------------
+
+class FoldinWorker:
+    """Tail → solve → publish, once per tick.
+
+    One worker per deploy; ``bind`` re-points it at each new model
+    generation (initial deploy and every /reload hot-swap) and queues
+    every previously folded user for re-fold into the fresh headroom —
+    the generation-coherent story: each generation's answers come from
+    exactly one model, and a new generation converges within a tick.
+
+    ``tick()`` is public and synchronous so tests drive the pipeline
+    deterministically; ``start()`` runs it on a daemon thread every
+    ``tick_ms``.
+    """
+
+    def __init__(self, storage: Any, config: FoldinConfig,
+                 cursor_directory: Optional[str] = None):
+        self.config = config
+        self._storage = storage
+        self._events = storage.get_events()
+        app = storage.get_meta_data_apps().get_by_name(config.app_name)
+        if app is None:
+            raise ValueError(
+                f"foldin: app {config.app_name!r} not found")
+        self.app_id = int(app.id)
+        self._tail = tail_for(self._events, self.app_id, config)
+        self._store = CursorStore(self.app_id, config.channel_id,
+                                  config.namespace, cursor_directory)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reload_pending = False
+
+        # model binding (set by bind())
+        self._model: Any = None
+        self._item_factors: Optional[np.ndarray] = None
+        self._capacity = 0
+        self._trained_users = 0
+        self.generation = 0
+        self._reload_cb: Optional[Callable[[], None]] = None
+
+        # bookkeeping
+        self._cursor: Any = None
+        self._folded: Dict[str, bool] = {}
+        self._pending: Dict[str, bool] = {}
+        self._ticks = 0
+        self._events_seen = 0
+        self._events_folded = 0
+        self._unknown_items = 0
+        self._last_tick_s = 0.0
+        self._last_tick_at = 0.0
+        self._last_error = ""
+        self._freshness: deque = deque(maxlen=1024)
+        self._recent: deque = deque(maxlen=64)   # drift-probe candidates
+        self._drift: Optional[Dict[str, Any]] = None
+
+        saved = self._store.load()
+        if saved is not None:
+            self._cursor = saved.get("cursor")
+            for u in saved.get("folded", []) + saved.get("pending", []):
+                self._pending[u] = True
+
+        reg = telemetry.registry()
+        self._m_fresh = reg.histogram(
+            "pio_foldin_freshness_seconds",
+            "Event ack to servable factor: how stale a fold-in answer "
+            "can be (realtime/foldin.py)",
+            buckets=_FRESHNESS_BUCKETS).labels()
+        self._m_lag = reg.gauge(
+            "pio_foldin_cursor_lag_events",
+            "Events between the fold-in cursor and the event-log head "
+            "after the latest tick").labels()
+        self._m_tick = reg.gauge(
+            "pio_foldin_last_tick_seconds",
+            "Wall-clock of the most recent fold-in tick (read + solve "
+            "+ publish; ends in the result host transfer)").labels()
+        self._m_users = reg.counter(
+            "pio_foldin_users_total",
+            "Fold-in user outcomes: folded (row updated), appended "
+            "(new user into headroom), pending (deferred to the next "
+            "tick/reload)", labelnames=("result",))
+        self._m_ticks = reg.counter(
+            "pio_foldin_ticks_total",
+            "Fold-in ticks by outcome (ok/empty/error)",
+            labelnames=("status",))
+        self._m_drift = reg.gauge(
+            "pio_foldin_drift_recall",
+            "Most recent drift-probe recall@10: published fold-in rows "
+            "vs a fresh half-step on the same events (KNOWN_ISSUES #13)"
+        ).labels()
+
+    # ------------------------------------------------------------- binding
+    @property
+    def supported(self) -> bool:
+        return self._tail is not None
+
+    def headroom_hint(self) -> int:
+        """Headroom the NEXT load should pre-pad: at least the config
+        value, and at least twice the users known to need re-folding
+        (so the reload fallback cannot immediately exhaust again)."""
+        with self._lock:
+            known = len(self._pending) + len(self._folded)
+        return max(self.config.headroom, 2 * known)
+
+    def bind(self, model: Any, generation: int,
+             prep: Dict[str, Any],
+             reload_cb: Optional[Callable[[], None]] = None) -> None:
+        """Point the worker at a freshly prepared model (initial deploy
+        or /reload). Every user folded into the PREVIOUS generation is
+        queued for re-fold — the new generation starts from the trained
+        factors, so fold-in state must be replayed into it."""
+        with self._lock:
+            for u in self._folded:
+                self._pending[u] = True
+            self._folded = {}
+            self._model = model
+            self._item_factors = np.asarray(prep["item_factors"],
+                                            dtype=np.float32)
+            self._trained_users = int(prep["trained_users"])
+            self.generation = int(generation)
+            self._reload_cb = reload_cb
+            self._reload_pending = False
+            self._capacity = self._resolve_capacity(model)
+            if self._cursor is None:
+                # first bind ever (no persisted state): training already
+                # consumed everything before the head
+                self._cursor = self._tail.head() if self._tail else None
+        journal.emit(
+            "foldin",
+            (f"fold-in worker bound to generation {generation} "
+             f"({len(self._pending)} user(s) queued for re-fold, "
+             f"capacity {self._capacity})"),
+            level=journal.INFO,
+            generation=int(generation), capacity=int(self._capacity),
+            pending=len(self._pending))
+        self._note_state()
+
+    @staticmethod
+    def _resolve_capacity(model: Any) -> int:
+        sharding = getattr(model, "sharding", None)
+        if sharding is not None:
+            return int(sharding.user_capacity)
+        quant = getattr(model, "quant", None)
+        if quant is not None:
+            return int(np.shape(quant.u_q)[0])
+        return int(np.shape(model.user_factors)[0])
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pio-foldin", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def _run(self) -> None:
+        tick_s = max(self.config.tick_ms, 1.0) / 1e3
+        while not self._stop.wait(tick_s):
+            try:
+                self.tick()
+            except Exception as e:  # the loop must survive anything
+                msg = f"{type(e).__name__}: {e}"
+                self._m_ticks.labels(status="error").inc()
+                if msg != self._last_error:
+                    # journal once per distinct failure, not per tick —
+                    # a wedged store must not flood the flight recorder
+                    self._last_error = msg
+                    logger.exception("foldin tick failed")
+                    journal.emit("foldin",
+                                 f"fold-in tick failed: {msg}",
+                                 level=journal.WARN, error=msg)
+
+    # ---------------------------------------------------------------- tick
+    def tick(self) -> Dict[str, Any]:
+        """One tail → solve → publish pass; returns a summary (tests
+        assert on it). Safe to call concurrently with serving — that is
+        the whole point — but not with itself (the worker thread is the
+        only caller in production)."""
+        with self._lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        if self._tail is None or self._model is None:
+            return {"folded": 0, "skipped": "unbound"}
+        new_cursor, rows = self._tail.read(self._cursor)
+        self._events_seen += len(rows)
+        # ack timestamps per user: freshness is measured from the
+        # OLDEST unserved event of each user in this window
+        acks: Dict[str, float] = {}
+        dirty: Dict[str, bool] = {}
+        for uid, _item, _ev, _rat, ack_ts in rows:
+            dirty[uid] = True
+            acks[uid] = min(acks.get(uid, ack_ts), ack_ts)
+        for uid in self._pending:
+            if uid not in dirty:
+                dirty[uid] = True
+        if not dirty:
+            self._cursor = new_cursor
+            self._persist()
+            self._finish_tick(t0, lag_only=True)
+            self._m_ticks.labels(status="empty").inc()
+            return {"folded": 0, "appended": 0, "events": len(rows)}
+
+        folded, appended, deferred = self._fold_users(list(dirty), acks)
+        self._cursor = new_cursor
+        self._persist()
+        self._finish_tick(t0)
+        self._ticks += 1
+        self._m_ticks.labels(status="ok").inc()
+        if drift_every() and self._ticks % drift_every() == 0:
+            self._drift_probe()
+        out = {"folded": folded, "appended": appended,
+               "deferred": deferred, "events": len(rows)}
+        if self._reload_pending and self._reload_cb is not None:
+            # headroom exhausted: generation-coherent fallback to the
+            # /reload hot-swap (QueryAPI._load re-pads with our hint
+            # and re-binds us; pending users re-fold right after)
+            cb, self._reload_cb = self._reload_cb, None
+            journal.emit(
+                "foldin",
+                "fold-in headroom exhausted; falling back to the "
+                "/reload hot-swap with re-grown capacity",
+                level=journal.WARN,
+                pending=len(self._pending), capacity=self._capacity)
+            cb()
+            out["reloaded"] = True
+        return out
+
+    def _finish_tick(self, t0: float, lag_only: bool = False) -> None:
+        dt = time.perf_counter() - t0
+        self._last_tick_s = dt
+        self._last_tick_at = _wall_now()
+        self._m_tick.set(dt)
+        try:
+            lag = self._tail.lag(self._cursor)
+        except Exception:
+            lag = -1
+        self._m_lag.set(float(max(lag, 0)))
+        self._lag = lag
+        self._note_state()
+
+    # ------------------------------------------------------------- folding
+    def _gather_ratings(self, uid: str,
+                        item_vocab: Any) -> Tuple[List[Tuple[int, float]],
+                                                  int]:
+        """The user's full (capped) rating history from the event
+        store, item-vocab-encoded — exactly the rows a retrain's
+        DataSource would emit for this user (buy → 4.0, all events
+        kept, most-recent ``PIO_FOLDIN_MAX_EVENTS`` on overflow)."""
+        cfg = self.config
+        evs = list(self._events.find(
+            self.app_id, channel_id=cfg.channel_id,
+            entity_type=cfg.entity_type, entity_id=uid,
+            event_names=list(cfg.event_names),
+            target_entity_type=cfg.target_entity_type))
+        evs.sort(key=lambda e: e.event_time)
+        cap = max_events_per_user()
+        if len(evs) > cap:
+            evs = evs[-cap:]
+        out: List[Tuple[int, float]] = []
+        unknown = 0
+        for e in evs:
+            if e.target_entity_id is None:
+                continue
+            ix = item_vocab.get(e.target_entity_id)
+            if ix is None:
+                unknown += 1
+                continue
+            if e.event == "buy":
+                rv = cfg.buy_rating
+            else:
+                v = e.properties.get_opt(cfg.rating_property) \
+                    if e.properties else None
+                try:
+                    rv = float(v)
+                except (TypeError, ValueError):
+                    continue
+            out.append((int(ix), rv))
+        return out, unknown
+
+    def _fold_users(self, uids: List[str],
+                    acks: Dict[str, float]) -> Tuple[int, int, int]:
+        model = self._model
+        user_vocab = model.user_vocab
+        item_vocab = model.item_vocab
+        buckets = user_buckets()
+        max_batch = buckets[-1]
+
+        # resolve rows + ratings first; partition known/new
+        work: List[Tuple[str, Optional[int], List[Tuple[int, float]]]] = []
+        for uid in uids:
+            ratings, unknown = self._gather_ratings(uid, item_vocab)
+            self._unknown_items += unknown
+            if not ratings:
+                # nothing usable yet (unknown items only, or the events
+                # were deleted): drop from pending, nothing to fold
+                self._pending.pop(uid, None)
+                continue
+            work.append((uid, user_vocab.get(uid), ratings))
+
+        folded = appended = deferred = 0
+        for at in range(0, len(work), max_batch):
+            batch = work[at:at + max_batch]
+            ixs: List[int] = []
+            entries: List[Tuple[str, int, List[Tuple[int, float]], bool]] \
+                = []
+            next_free = len(user_vocab)
+            for uid, known_ix, ratings in batch:
+                if known_ix is not None:
+                    entries.append((uid, int(known_ix), ratings, False))
+                elif next_free < self._capacity:
+                    entries.append((uid, next_free, ratings, True))
+                    next_free += 1
+                else:
+                    # headroom exhausted: keep the user pending and arm
+                    # the reload fallback after this tick publishes
+                    self._pending[uid] = True
+                    self._m_users.labels(result="pending").inc()
+                    self._reload_pending = True
+                    deferred += 1
+            if not entries:
+                continue
+            rows = self._solve(
+                [ratings for _u, _ix, ratings, _new in entries])
+            pub_ix = np.asarray([ix for _u, ix, _r, _n in entries],
+                                np.int32)
+            self._publish(model, pub_ix, rows)
+            now = _wall_now()
+            for (uid, ix, _ratings, is_new), _row in zip(entries, rows):
+                if is_new:
+                    # row first, vocab second: a query resolves the new
+                    # user only after its factors are live
+                    user_vocab.add(uid, int(ix))
+                    appended += 1
+                    self._m_users.labels(result="appended").inc()
+                else:
+                    folded += 1
+                    self._m_users.labels(result="folded").inc()
+                self._pending.pop(uid, None)
+                self._folded[uid] = True
+                self._events_folded += 1
+                self._recent.append(uid)
+                if uid in acks:
+                    fresh = max(now - acks[uid], 0.0)
+                    self._freshness.append(fresh)
+                    self._m_fresh.observe(fresh)
+        return folded, appended, deferred
+
+    def _solve(self, rating_lists: List[List[Tuple[int, float]]]
+               ) -> np.ndarray:
+        """Batch half-step for this tick's users (padded onto the
+        smallest declared bucket); returns host (n, r) fp32 rows."""
+        n = len(rating_lists)
+        bucket = next((b for b in user_buckets() if b >= n),
+                      user_buckets()[-1])
+        me = max_events_per_user()
+        nnz_pad = bucket * me
+        rank = int(self._item_factors.shape[1])
+        item_rows = np.zeros((nnz_pad, rank), np.float32)
+        self_idx = np.full((nnz_pad,), bucket, np.int32)
+        rating = np.zeros((nnz_pad,), np.float32)
+        counts = np.zeros((bucket,), np.int32)
+        pos = 0
+        for j, ratings in enumerate(rating_lists):
+            counts[j] = len(ratings)
+            for item_ix, rv in ratings:
+                item_rows[pos] = self._item_factors[item_ix]
+                self_idx[pos] = j
+                rating[pos] = rv
+                pos += 1
+        with devicewatch.attribution("foldin_solve", phase="foldin"):
+            out = foldin_solve(
+                item_rows, self_idx, rating, counts,
+                np.float32(self.config.lambda_),
+                n_self=bucket, chunk=nnz_pad,
+                reg_scaling=self.config.reg_scaling)
+        return np.array(jax.device_get(out)[:n])
+
+    # ------------------------------------------------------------- publish
+    def _pub_pad(self, ixs: np.ndarray,
+                 rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad the publication batch onto a declared bucket so the
+        scatter rides a prebuilt program (duplicate index 0 entries
+        carry the identical row — a deterministic no-op)."""
+        n = ixs.shape[0]
+        bucket = next((b for b in user_buckets() if b >= n),
+                      user_buckets()[-1])
+        if bucket == n:
+            return ixs, rows
+        pad = bucket - n
+        return (np.concatenate([ixs, np.full((pad,), ixs[0], np.int32)]),
+                np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)]))
+
+    def _publish(self, model: Any, ixs: np.ndarray,
+                 rows: np.ndarray) -> None:
+        """Atomic row publication into the live serving layout (the
+        module docstring's per-layout contract). Each branch ends in
+        ONE reference swap (or GIL-held in-place row writes for host
+        numpy), so a concurrent query sees either the old or the new
+        rows — never a torn mix — and none is ever dropped."""
+        rows = np.asarray(rows, np.float32)
+        sharding = getattr(model, "sharding", None)
+        quant = getattr(model, "quant", None)
+        if sharding is not None:
+            p_ix, p_rows = self._pub_pad(ixs, rows)
+            with devicewatch.attribution("foldin_publish", phase="foldin"):
+                new = sharding.apply_user_rows(p_ix, p_rows)
+            model.user_factors = new.user_shards
+            model.sharding = new       # the swap queries dispatch on
+            return
+        if quant is not None:
+            p_ix, p_rows = self._pub_pad(ixs, rows)
+            with devicewatch.attribution("foldin_publish", phase="foldin"):
+                new_q = quant.apply_user_rows(p_ix, p_rows)
+            uf = model.user_factors
+            if isinstance(uf, np.ndarray) and uf.shape[0] > int(ixs.max()):
+                uf[ixs] = rows         # host fp32 mirror (eval paths)
+            model.quant = new_q        # the swap queries dispatch on
+            return
+        uf = model.user_factors
+        if isinstance(uf, np.ndarray):
+            uf[ixs] = rows             # small-array numpy: GIL-held
+            return
+        p_ix, p_rows = self._pub_pad(ixs, rows)
+        with devicewatch.attribution("foldin_publish", phase="foldin"):
+            model.user_factors = scatter_user_rows(uf, p_ix, p_rows)
+
+    def _published_row(self, model: Any, ix: int) -> np.ndarray:
+        sharding = getattr(model, "sharding", None)
+        if sharding is not None:
+            if sharding.dtype == "int8":
+                q = jax.device_get(sharding.user_shards[ix])
+                s = jax.device_get(sharding.user_scales[ix])
+                return q.astype(np.float32) * np.float32(s)
+            return np.asarray(jax.device_get(sharding.user_shards[ix]))
+        quant = getattr(model, "quant", None)
+        if quant is not None:
+            q = jax.device_get(quant.u_q[ix])
+            s = jax.device_get(quant.u_scale[ix])
+            return q.astype(np.float32) * np.float32(s)
+        uf = model.user_factors
+        if isinstance(uf, np.ndarray):
+            return uf[ix].copy()
+        return np.asarray(jax.device_get(uf[ix]))
+
+    # --------------------------------------------------------- drift probe
+    def _drift_probe(self, sample: int = 4, k: int = 10) -> None:
+        """Published rows vs a fresh half-step from scratch on the same
+        rows, compared as RANKINGS over the item matrix (recall@k —
+        the KNOWN_ISSUES #12 posture; #13 documents why bit-parity is
+        the wrong ask for the int8 layouts). A failed probe WARNs the
+        journal and flips the doctor fold-in line to WARN — live-state
+        checks own paging, so never RED."""
+        model = self._model
+        uids = list(dict.fromkeys(reversed(self._recent)))[:sample]
+        if not uids or self._item_factors is None:
+            return
+        V = self._item_factors
+        recalls: List[float] = []
+        for uid in uids:
+            ix = model.user_vocab.get(uid)
+            if ix is None:
+                continue
+            ratings, _unknown = self._gather_ratings(uid, model.item_vocab)
+            if not ratings:
+                continue
+            fresh = self._solve([ratings])[0]
+            pub = self._published_row(model, int(ix))
+            kk = min(k, V.shape[0])
+            if kk >= V.shape[0]:
+                # k covering the whole catalog makes recall trivially
+                # 1.0; on tiny catalogs probe the top half instead
+                kk = max(V.shape[0] // 2, 1)
+            top_f = np.argsort(-(V @ fresh), kind="stable")[:kk]
+            top_p = np.argsort(-(V @ pub), kind="stable")[:kk]
+            recalls.append(
+                np.intersect1d(top_f, top_p).size / max(kk, 1))
+        if not recalls:
+            return
+        recall = float(np.mean(recalls))
+        ok = recall >= drift_recall_floor()
+        self._drift = {"recall": round(recall, 4), "ok": ok,
+                       "sampled": len(recalls),
+                       "checkedAt": _wall_now()}
+        self._m_drift.set(recall)
+        if not ok:
+            journal.emit(
+                "foldin",
+                (f"fold-in drift probe FAILED: recall@{k} "
+                 f"{recall:.4f} < {drift_recall_floor():.2f} floor "
+                 "(published rows diverge from a fresh half-step; "
+                 "KNOWN_ISSUES #13)"),
+                level=journal.WARN, recall=round(recall, 4),
+                floor=drift_recall_floor(), sampled=len(recalls))
+        self._note_state()
+
+    # --------------------------------------------------------------- state
+    def _persist(self) -> None:
+        try:
+            self._store.save(self._cursor, list(self._folded),
+                             list(self._pending))
+        except OSError:
+            logger.warning("foldin: cursor persist failed at %s",
+                           self._store.path, exc_info=True)
+
+    def _freshness_pct(self, q: float) -> Optional[float]:
+        if not self._freshness:
+            return None
+        return float(np.percentile(np.asarray(self._freshness), q))
+
+    def state(self) -> Dict[str, Any]:
+        """The fold-in block for ``GET /``, ``/debug/device.json`` and
+        the `pio doctor` fold-in line."""
+        with self._lock:
+            cap = self._capacity
+            used = len(self._model.user_vocab) if self._model is not None \
+                else 0
+            out: Dict[str, Any] = {
+                "enabled": True,
+                "backend": self._tail.kind if self._tail else None,
+                "generation": self.generation,
+                "tickMs": self.config.tick_ms,
+                "ticks": self._ticks,
+                "cursorLag": getattr(self, "_lag", None),
+                "lastTickMs": round(self._last_tick_s * 1e3, 3),
+                "lastTickAt": self._last_tick_at or None,
+                "usersFolded": len(self._folded),
+                "usersPending": len(self._pending),
+                "eventsSeen": self._events_seen,
+                "unknownItems": self._unknown_items,
+                "capacity": {"rows": cap, "used": used,
+                             "headroomLeft": max(cap - used, 0)},
+            }
+            p50 = self._freshness_pct(50)
+            p99 = self._freshness_pct(99)
+            if p99 is not None:
+                out["freshness"] = {"p50S": round(p50, 4),
+                                    "p99S": round(p99, 4),
+                                    "observed": len(self._freshness)}
+            if self._drift is not None:
+                out["drift"] = dict(self._drift)
+            return out
+
+    def _note_state(self) -> None:
+        try:
+            devicewatch.note_foldin(self.state())
+        except Exception:  # the debug surface must never fail a tick
+            logger.debug("foldin: state note failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# standalone soak runner (`pio foldin`)
+# ---------------------------------------------------------------------------
+
+def run_standalone(engine_dir: str = ".", variant: str = "engine.json",
+                   engine_instance_id: Optional[str] = None,
+                   tick_ms: float = 0.0, max_ticks: Optional[int] = None,
+                   storage: Any = None, out=None) -> int:
+    """Dry-run/soak mode: load the latest COMPLETED instance's model
+    into THIS process, run the fold-in pipeline against the live event
+    stream, and report freshness/lag/drift — validating fold-in on a
+    host (or in CI) without touching a serving fleet. Publication goes
+    into the local model copy only; the cursor lives in its own
+    ``standalone`` namespace so a co-located ``pio deploy --foldin``
+    worker is never starved. Exit 0 on a clean run, 1 when the backend
+    exposes no incremental tail."""
+    import builtins
+    echo = out or builtins.print
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.workflow import model_io
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig, engine_params_from_instance, resolve_engine_instance,
+    )
+    from predictionio_tpu.workflow.workflow_utils import get_engine
+
+    storage = storage or get_storage()
+    instance = resolve_engine_instance(storage, ServerConfig(
+        engine_instance_id=engine_instance_id,
+        engine_dir=os.path.abspath(engine_dir)))
+    engine = get_engine(instance.engine_factory,
+                        base_dir=os.path.abspath(engine_dir))
+    engine_params = engine_params_from_instance(engine, instance)
+    blob = storage.get_model_data_models().get(instance.id)
+    if blob is None:
+        raise ValueError(f"No model data for EngineInstance {instance.id}")
+    models = model_io.deserialize_models(blob.models)
+    _, _, algorithms, _serving = engine._instantiate(engine_params)
+    cfg = config_for(engine_params, tick_ms=tick_ms)
+    if cfg is None:
+        raise ValueError("engine is not fold-in-shaped (no datasource "
+                         "appName)")
+    cfg.namespace = "standalone"
+    prep = pad_capacity(models, default_headroom(), algorithms)
+    if prep is None:
+        raise ValueError("no ALS-shaped model to fold into")
+    if prep.get("lambda_") is not None:
+        cfg.lambda_ = prep["lambda_"]
+    worker = FoldinWorker(storage, cfg)
+    if not worker.supported:
+        echo("[ERROR] this event-store backend exposes no incremental "
+             "tail (see the fold-in backend matrix in README.md)")
+        return 1
+    worker.bind(models[prep["index"]], generation=1, prep=prep)
+    echo(f"[INFO] fold-in soak on app {cfg.app_name!r} (instance "
+         f"{instance.id}, tick {cfg.tick_ms:g} ms, capacity "
+         f"{worker.state()['capacity']['rows']}); Ctrl-C to stop")
+    tick_s = max(cfg.tick_ms, 1.0) / 1e3
+    ticks = 0
+    try:
+        while max_ticks is None or ticks < max_ticks:
+            summary = worker.tick()
+            ticks += 1
+            if summary.get("folded") or summary.get("appended") \
+                    or ticks % max(int(2.0 / tick_s), 1) == 0:
+                st = worker.state()
+                fr = st.get("freshness") or {}
+                echo(f"[INFO] tick {ticks}: folded={summary.get('folded', 0)} "
+                     f"appended={summary.get('appended', 0)} "
+                     f"lag={st.get('cursorLag')} "
+                     f"freshness_p99_s={fr.get('p99S')}")
+            time.sleep(tick_s)
+    except KeyboardInterrupt:
+        pass
+    st = worker.state()
+    echo(f"[INFO] fold-in soak done: {st['usersFolded']} user(s) folded, "
+         f"lag {st.get('cursorLag')}, drift "
+         f"{(st.get('drift') or {}).get('recall')}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# AOT registry entries (the tier-1 lint checks every @jax.jit def in
+# this module against the registry)
+# ---------------------------------------------------------------------------
+
+def _register() -> None:
+    from predictionio_tpu.serving import aot
+    aot.register_jit(
+        "foldin_solve", foldin_solve, kind="serving",
+        note="enumerated per user bucket by solve_program_specs when "
+             "the deploy runs with fold-in on; shapes are (bucket x "
+             "PIO_FOLDIN_MAX_EVENTS), model-size-independent")
+    aot.register_jit(
+        "scatter_user_rows", scatter_user_rows, kind="serving",
+        note="fold-in publication scatter for the replicated device-"
+             "fp32 layout; enumerated per publication bucket by "
+             "publication_program_specs")
+
+
+_register()

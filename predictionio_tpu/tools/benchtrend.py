@@ -102,6 +102,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     ("serve_quant_p99_ms", "down", False),
     ("serve_quant_hbm_ratio", "down", False),
     ("serve_quant_recall", "up", False),
+    # realtime fold-in era (realtime/foldin.py): wire-level freshness
+    # (event ack -> first personalized answer for an unseen user — the
+    # speed-layer contract, hard-gated at <= 2 s by the bench's own
+    # fold-in leg under BENCH_STRICT_EXTRAS=1), the worker's serve-p99
+    # tax (hard-gated at <= 5% there), and the cursor lag at the end of
+    # the leg — trended so speed-layer rot is visible round over round
+    ("foldin_freshness_p99_s", "down", False),
+    ("foldin_overhead_p99_pct", "down", False),
+    ("foldin_cursor_lag_events", "down", False),
     # static-analysis era (tools/analyze): `pio lint` runs inside the
     # bench's strict leg; findings are gated at 0 absolutely below,
     # suppressed counts are trended so baseline debt is visible per

@@ -217,6 +217,9 @@ def cmd_deploy(args) -> int:
         slo_latency_ms=args.slo_latency_ms,
         shard_serving=args.shard_serving,
         serve_quant=args.serve_quant,
+        foldin=args.foldin,
+        foldin_tick_ms=args.foldin_tick_ms,
+        foldin_headroom=args.foldin_headroom,
     )
     if args.compile_cache:
         os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
@@ -235,6 +238,21 @@ def cmd_deploy(args) -> int:
           f"http://{args.ip}:{args.port}.")
     serve(api, host=args.ip, port=args.port)
     return 0
+
+
+def cmd_foldin(args) -> int:
+    """Standalone fold-in soak (realtime/foldin.py run_standalone):
+    load the latest COMPLETED instance's model into this process, run
+    the tail→solve→publish pipeline against the live event stream, and
+    report freshness/lag/drift — validating fold-in on a host without
+    touching a serving fleet. Publication stays local (own model copy,
+    own `standalone` cursor namespace); `pio deploy --foldin` is the
+    serving integration. Exit 0 clean / 1 unsupported backend."""
+    from predictionio_tpu.realtime.foldin import run_standalone
+    return run_standalone(
+        engine_dir=args.engine_dir, variant=args.variant,
+        engine_instance_id=args.engine_instance_id,
+        tick_ms=args.tick_ms, max_ticks=args.max_ticks or None)
 
 
 def cmd_profile(args) -> int:
@@ -704,6 +722,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "auto = accelerator backends only, gated by "
                          "the deploy-time recall probe; composes with "
                          "--shard-serving; PIO_SERVE_QUANT overrides)")
+    sp.add_argument("--foldin", choices=("on", "off"), default="off",
+                    help="run the realtime fold-in worker in-process "
+                         "(realtime/foldin.py): tail the event store, "
+                         "re-solve dirty users against the fixed item "
+                         "matrix with the ALS half-step, publish rows "
+                         "atomically into the live model — new users "
+                         "get personalized top-k in seconds without a "
+                         "retrain (PIO_FOLDIN=0/1 overrides)")
+    sp.add_argument("--foldin-tick-ms", type=float, default=0.0,
+                    help="fold-in tick cadence in ms (0 = "
+                         "PIO_FOLDIN_TICK_MS or 250)")
+    sp.add_argument("--foldin-headroom", type=int, default=0,
+                    help="user-row capacity pre-padded for fold-in "
+                         "appends (0 = PIO_FOLDIN_HEADROOM or 1024)")
     sp.add_argument("--slo-availability", type=float, default=None,
                     help="availability SLO target, e.g. 0.999 "
                          "(default PIO_SLO_AVAILABILITY or 0.999)")
@@ -715,6 +747,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("undeploy", help="stop a deployed engine server")
     sp.add_argument("--ip", default="localhost")
     sp.add_argument("--port", type=int, default=8000)
+
+    sp = sub.add_parser(
+        "foldin",
+        help="standalone realtime fold-in soak: tail the event store "
+             "and re-solve dirty users against the latest trained "
+             "model in this process (dry-run twin of `pio deploy "
+             "--foldin`; exit 0 clean / 1 unsupported backend)")
+    engine_flags(sp)
+    sp.add_argument("--engine-instance-id", default=None)
+    sp.add_argument("--tick-ms", type=float, default=0.0,
+                    help="tick cadence in ms (0 = PIO_FOLDIN_TICK_MS "
+                         "or 250)")
+    sp.add_argument("--max-ticks", type=int, default=0,
+                    help="stop after N ticks (0 = run until Ctrl-C)")
 
     sp = sub.add_parser(
         "doctor",
@@ -906,6 +952,7 @@ _DISPATCH = {
     "eval": cmd_eval,
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
+    "foldin": cmd_foldin,
     "doctor": cmd_doctor,
     "trace": cmd_trace,
     "events": cmd_events,

@@ -442,6 +442,53 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
             detail += f", last recall gate {recall:.4f}"
         checks.append(("quant", OK, detail))
 
+    # realtime fold-in (realtime/foldin.py) ----------------------------
+    foldin_info = device.get("foldin") or {}
+    foldin_lag = metric_max(samples, "pio_foldin_cursor_lag_events")
+    if not foldin_info and foldin_lag is None:
+        checks.append(("foldin", NA,
+                       _OPT_IN.format("the fold-in worker state")
+                       if telemetry_off
+                       else "fold-in off (batch-only serving; enable "
+                            "with pio deploy --foldin)"))
+    else:
+        lag = foldin_info.get("cursorLag")
+        if lag is None:
+            lag = int(foldin_lag or 0)
+        last_ms = foldin_info.get("lastTickMs")
+        fresh = foldin_info.get("freshness") or {}
+        drift = foldin_info.get("drift") or {}
+        detail = f"cursor lag {lag}"
+        if last_ms is not None:
+            detail += f", last tick {last_ms:g} ms"
+        if fresh.get("p99S") is not None:
+            detail += f", freshness p99 {fresh['p99S']:g} s"
+        if drift.get("recall") is not None:
+            detail += (f", drift probe recall {drift['recall']:.4f}"
+                       + ("" if drift.get("ok") else " FAILED"))
+        import datetime as _dtmod2
+        now_ts = _dtmod2.datetime.now(
+            _dtmod2.timezone.utc).timestamp()
+        tick_ms = float(foldin_info.get("tickMs") or 250.0)
+        last_at = foldin_info.get("lastTickAt")
+        stale_after = max(10 * tick_ms / 1e3, 30.0)
+        stale = (last_at is not None
+                 and now_ts - float(last_at) > stale_after)
+        # WARN, never RED: the fold-in line is a freshness advisory —
+        # the live-state checks above own paging (PR 12 convention)
+        if stale:
+            checks.append(("foldin", WARN,
+                           detail + f" — STALE: no tick for "
+                           f"{now_ts - float(last_at):.0f} s (worker "
+                           "wedged? event store unreachable?)"))
+        elif drift and not drift.get("ok", True):
+            checks.append(("foldin", WARN,
+                           detail + " — published rows diverge from a "
+                           "fresh half-step (KNOWN_ISSUES #13); a "
+                           "retrain will resync"))
+        else:
+            checks.append(("foldin", OK, detail))
+
     # HBM headroom -----------------------------------------------------
     in_use = metric_sum(samples, "pio_hbm_bytes_in_use")
     limit = metric_sum(samples, "pio_hbm_bytes_limit")

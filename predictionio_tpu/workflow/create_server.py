@@ -118,6 +118,20 @@ class ServerConfig:
     #: bit-compatible fp32 path. Composes with shard_serving (int8
     #: shards). PIO_SERVE_QUANT overrides.
     serve_quant: str = "auto"
+    #: realtime fold-in (realtime/foldin.py): "on" runs the streaming
+    #: speed-layer worker in-process — tail the event store, re-solve
+    #: dirty users against the fixed item matrix with the ALS
+    #: half-step, publish rows atomically into the live serving model
+    #: (new users append into pre-padded headroom; exhaustion falls
+    #: back to the /reload hot-swap). "off" (default) keeps every
+    #: endpoint byte-identical. PIO_FOLDIN overrides.
+    foldin: str = "off"
+    #: fold-in tick cadence in ms (how often the tail is read and
+    #: dirty users are re-solved; 0 = PIO_FOLDIN_TICK_MS or 250)
+    foldin_tick_ms: float = 0.0
+    #: user-row capacity headroom pre-padded at load for fold-in
+    #: appends (0 = PIO_FOLDIN_HEADROOM or 1024)
+    foldin_headroom: int = 0
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -248,6 +262,9 @@ class QueryAPI:
         self._aot_state: Optional[Dict[str, Any]] = None
         self._shard_state: Optional[Dict[str, Any]] = None
         self._quant_state: Optional[Dict[str, Any]] = None
+        #: realtime fold-in worker (realtime/foldin.py) — one per
+        #: server, re-bound to each model generation by _load
+        self._foldin_worker = None
         reg = telemetry.registry()
         self._m_time_to_ready = reg.gauge(
             "pio_time_to_ready_seconds",
@@ -290,6 +307,23 @@ class QueryAPI:
         models = prepare_deploy(
             self.ctx, engine, engine_params, instance.id, models,
             algorithms=algorithms)
+        # realtime fold-in (realtime/foldin.py): capacity headroom must
+        # be padded BEFORE prepare_serving so every layout (replicated,
+        # sharded, int8) and every AOT program shape already includes
+        # the rows new users will fold into — a later resize would be
+        # the recompile cliff. A reload re-pads with the worker's hint
+        # so the headroom-exhausted fallback always lands with room.
+        from predictionio_tpu.realtime import foldin as foldin_mod
+        foldin_on = foldin_mod.enabled(self.config.foldin)
+        foldin_prep = None
+        if foldin_on:
+            headroom = (self.config.foldin_headroom
+                        or foldin_mod.default_headroom())
+            if self._foldin_worker is not None:
+                headroom = max(headroom,
+                               self._foldin_worker.headroom_hint())
+            foldin_prep = foldin_mod.pad_capacity(
+                models, headroom, algorithms)
         # shard-serving + serve-quant scopes (parallel/serve_dist.py,
         # ops/quant.py): each algorithm's prepare_serving resolves the
         # deploy's modes inside them. A reload is flagged so sharding's
@@ -315,8 +349,10 @@ class QueryAPI:
         quant_state = serve_quant.summarize_deploy(
             models, requested=quant_requested)
         serve_quant.record_state(quant_state)
+        foldin_specs = (foldin_mod.program_specs(models, foldin_prep)
+                        if foldin_on else [])
         aot_state, serve_buckets = self._prebuild_aot(
-            instance, algorithms, models)
+            instance, algorithms, models, extra_specs=foldin_specs)
         batcher = self._make_batcher(algorithms, models, serving,
                                      buckets=serve_buckets)
         with self._lock:
@@ -350,8 +386,55 @@ class QueryAPI:
             generation=self.generation, instanceId=instance.id,
             reload=bool(is_reload),
             timeToReadyS=round(self.time_to_ready_s, 3))
+        if foldin_on and foldin_prep is not None:
+            self._install_foldin(engine_params, models, foldin_prep)
+        elif foldin_on:
+            journal.emit(
+                "foldin", "fold-in requested but no model is fold-in-"
+                "shaped (user/item factor matrices + vocabs); worker "
+                "not started", level=journal.WARN)
 
-    def _prebuild_aot(self, instance, algorithms, models):
+    def _install_foldin(self, engine_params, models, prep) -> None:
+        """Create (first load) or re-bind (reload) the fold-in worker
+        against the freshly swapped model generation. Degrades soft:
+        an engine without an appName, a backend without an incremental
+        tail, or a missing app journals a WARN and serves without the
+        speed layer — never a dead deploy."""
+        from predictionio_tpu.realtime import foldin as foldin_mod
+        worker = self._foldin_worker
+        if worker is None:
+            cfg = foldin_mod.config_for(
+                engine_params, tick_ms=self.config.foldin_tick_ms,
+                headroom=self.config.foldin_headroom or None)
+            if cfg is None:
+                journal.emit(
+                    "foldin", "fold-in requested but the engine has no "
+                    "datasource appName to tail; worker not started",
+                    level=journal.WARN)
+                return
+            if prep.get("lambda_") is not None:
+                cfg.lambda_ = prep["lambda_"]
+            try:
+                worker = foldin_mod.FoldinWorker(self.storage, cfg)
+            except ValueError as e:
+                journal.emit(
+                    "foldin", f"fold-in worker failed to start: {e}",
+                    level=journal.WARN, error=str(e))
+                return
+            if not worker.supported:
+                journal.emit(
+                    "foldin", "fold-in requested but this event-store "
+                    "backend exposes no incremental tail (see the "
+                    "README fold-in matrix); worker not started",
+                    level=journal.WARN)
+                return
+            self._foldin_worker = worker
+        worker.bind(models[prep["index"]], generation=self.generation,
+                    prep=prep, reload_cb=self._reload)
+        worker.start()
+
+    def _prebuild_aot(self, instance, algorithms, models,
+                      extra_specs=None):
         """Kill the warmup cliff before /readyz flips ready
         (serving/aot.py): pre-seed the persistent compile cache from
         the instance's exported artifact, prune the padding-bucket set
@@ -389,6 +472,10 @@ class QueryAPI:
         specs = []
         for a, m in zip(algorithms, models):
             specs.extend(aot.algorithm_programs(a, m, buckets))
+        # fold-in programs (realtime/foldin.py): the per-bucket solve +
+        # publication scatters ride the same prebuild, so the first
+        # tick after /readyz compiles nothing
+        specs.extend(extra_specs or [])
         report = aot.prebuild(specs,
                               threads=self.config.aot_threads or None)
         devicewatch.mark_serving_warmup_done()
@@ -485,6 +572,12 @@ class QueryAPI:
                      "queries; flushing admitted batches",
                      level=journal.INFO, generation=self.generation)
         t0 = time.perf_counter()
+        worker = self._foldin_worker
+        if worker is not None:
+            # the speed layer stops BEFORE the batcher drains: no new
+            # publications race the final flushes (in-flight queries
+            # still answer from the last published generation)
+            worker.stop()
         with self._lock:
             batcher = self._batcher
         if batcher is not None:
@@ -500,6 +593,9 @@ class QueryAPI:
     def close(self) -> None:
         """Drain and retire the request batcher (server shutdown). Queries
         arriving afterwards fall back to the inline single-query path."""
+        worker = self._foldin_worker
+        if worker is not None:
+            worker.stop()
         with self._lock:
             batcher, self._batcher = self._batcher, None
         if batcher is not None:
@@ -581,6 +677,11 @@ class QueryAPI:
             # fell back (the operator must be able to see the fallback);
             # fp32 deploys keep the exact legacy key set (wire parity)
             out["quant"] = self._quant_state
+        worker = getattr(self, "_foldin_worker", None)
+        if worker is not None:
+            # only with the fold-in worker live: PIO_FOLDIN=0 deploys
+            # keep the exact legacy key set (wire parity, asserted)
+            out["foldin"] = worker.state()
         return out
 
     def _readyz(self) -> Response:

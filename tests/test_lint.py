@@ -284,6 +284,25 @@ def test_declarations_pass_accepts_declared_journal_category():
                 if f.rule == "journal-undeclared"]
 
 
+def test_declarations_pass_fires_on_undeclared_category_in_realtime():
+    """The new realtime subsystem is inside the journal-undeclared
+    scope like everything else: a fold-in emitter with a typo'd
+    category fails the lint, and its real `foldin` category passes."""
+    src = ("from predictionio_tpu.common import journal\n"
+           "journal.emit('fold_in_typo_xyz', 'headroom gone',\n"
+           "             level=journal.WARN)\n")
+    found = [f for f in declarations.run(
+        [_mod(src, rel="predictionio_tpu/realtime/foldin.py")],
+        readme_text="") if f.rule == "journal-undeclared"]
+    assert len(found) == 1 and "fold_in_typo_xyz" in found[0].message
+    ok = ("from predictionio_tpu.common import journal\n"
+          "journal.emit('foldin', 'worker bound',\n"
+          "             level=journal.INFO)\n")
+    assert not [f for f in declarations.run(
+        [_mod(ok, rel="predictionio_tpu/realtime/foldin.py")],
+        readme_text="") if f.rule == "journal-undeclared"]
+
+
 def test_declarations_pass_clean_on_real_repo_and_readme():
     """Every PIO_* read, pio_* metric, and journal.emit category in the
     real tree is declared in common/declarations.py and (env/metric)
