@@ -1843,6 +1843,88 @@ def _cache_artifact_roundtrip(storage, instance_id: str):
         shutil.rmtree(fresh, ignore_errors=True)
 
 
+def measure_train_stream(storage, engine, nnz: int, n_iters: int = 2):
+    """Out-of-core training leg (ROADMAP item 6): the SAME front-door
+    `pio train` over the same event store, in-core (PIO_TRAIN_STREAM=off)
+    vs streamed (=on), with the layout cache disabled so both legs pay
+    the full read + layout + train pipeline. Records end-to-end
+    pipeline ratings/s for each mode, the peak host RSS and — the
+    number the O(chunk) claim rests on — the peak PIPELINE RSS (RSS
+    minus live jax array bytes, which is what isolates host-side
+    staging on CPU backends where device buffers share the RSS;
+    KNOWN_ISSUES #14). Strict gates: bit-identical model checksums
+    (streamed training is a memory optimization, not a different
+    model), streamed ratings/s >= 85% of in-core, streamed pipeline
+    peak <= 1.10x in-core."""
+    from predictionio_tpu.common import devicewatch
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams,
+    )
+    from predictionio_tpu.workflow import run_train
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    saved = {k: os.environ.get(k)
+             for k in ("PIO_TRAIN_STREAM", "PIO_ALS_LAYOUT_CACHE")}
+
+    def leg(mode):
+        os.environ["PIO_TRAIN_STREAM"] = mode
+        os.environ["PIO_ALS_LAYOUT_CACHE"] = "0"
+        ctx = WorkflowContext(storage=storage)
+        with devicewatch.RssWatcher() as w:
+            t0 = time.perf_counter()
+            iid = run_train(
+                ctx, engine,
+                EngineParams(
+                    data_source_params=DataSourceParams(appName="BenchApp"),
+                    algorithm_params_list=(("als", ALSAlgorithmParams(
+                        rank=10, numIterations=n_iters, lambda_=0.01,
+                        seed=21)),)),
+                engine_factory="bench-stream")
+            ck = model_checksum(storage, iid)  # host barrier inside timer
+            wall = time.perf_counter() - t0
+        ph = dict(ctx.phase_seconds)
+        # read_io/read_encode are SUB-phases of "read" — summing them in
+        # again would double-count the scan
+        core_s = (ph.get("read", 0.0) + ph.get("layout", 0.0)
+                  + ph.get("train", 0.0))
+        return {
+            "wall_s": round(wall, 3),
+            "core_s": round(core_s, 3),
+            "ratings_per_s": round(nnz * n_iters / max(core_s, 1e-9)),
+            "peak_rss_mb": round(w.peak_rss / 2**20, 1),
+            "peak_pipeline_mb": round(w.peak_pipeline / 2**20, 1),
+            "checksum": ck,
+        }
+
+    try:
+        off = leg("off")
+        on = leg("on")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ratio = on["ratings_per_s"] / max(off["ratings_per_s"], 1e-9)
+    return {
+        "train_stream_off": off,
+        "train_stream_on": on,
+        "train_stream_ratings_per_s": on["ratings_per_s"],
+        "train_stream_peak_rss_mb": on["peak_rss_mb"],
+        "train_stream_peak_pipeline_mb": on["peak_pipeline_mb"],
+        "train_stream_rss_delta_mb": round(
+            off["peak_pipeline_mb"] - on["peak_pipeline_mb"], 1),
+        "train_stream_rate_ratio": round(ratio, 3),
+        "train_stream_rate_ok": ratio >= 0.85,
+        "train_stream_rss_ok": (
+            on["peak_pipeline_mb"] <= off["peak_pipeline_mb"] * 1.10 + 64),
+        "train_stream_bitparity_ok": (
+            np.isfinite(off["checksum"]) and np.isfinite(on["checksum"])
+            and off["checksum"] == on["checksum"]),
+    }
+
+
 def serve_and_measure(storage, engine, n_queries: int = 200):
     """Deploy via QueryAPI + HTTP and time front-door query round-trips."""
     import http.client
@@ -2183,6 +2265,19 @@ def main() -> None:
                 recompile_watch = {
                     "recompile_watch_error": f"{type(e).__name__}: {e}"}
 
+        # out-of-core training leg (data/store.py stream mode): in-core
+        # vs streamed `pio train` over the same store — pipeline
+        # ratings/s, peak host RSS, and the bit-parity contract; runs
+        # AFTER the serving legs so its extra COMPLETED instances never
+        # change which model those legs deploy
+        stream_leg = None
+        if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+            try:
+                stream_leg = measure_train_stream(storage, engine, nnz)
+            except Exception as e:
+                stream_leg = {"train_stream_error":
+                              f"{type(e).__name__}: {e}"}
+
         # parity leg AFTER the timed passes: it reuses the already-compiled
         # hybrid program and adds only the csrb one, so warmup_compile_s
         # above stays an honest per-process compile measurement
@@ -2318,6 +2413,7 @@ def main() -> None:
                 **(shard_leg or {}),
                 **(quant_leg or {}),
                 **(recompile_watch or {}),
+                **(stream_leg or {}),
                 **(eval_grid or {}),
                 **(ecom or {}),
                 **(robust or {}),
@@ -2540,6 +2636,29 @@ def main() -> None:
                     failures.append(
                         "quantized HBM-ceiling leg: the 3.5x catalog "
                         "did not serve int8-sharded with "
+                        "BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and stream_leg:
+            if stream_leg.get("train_stream_error"):
+                failures.append(
+                    "train-stream leg crashed "
+                    f"({stream_leg['train_stream_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                if not stream_leg.get("train_stream_bitparity_ok"):
+                    failures.append(
+                        "streamed and in-core trains produced DIFFERENT "
+                        "model checksums (bit-parity contract broken) "
+                        "with BENCH_STRICT_EXTRAS=1")
+                if not stream_leg.get("train_stream_rate_ok"):
+                    failures.append(
+                        "streamed training pipeline rate is "
+                        f"{stream_leg.get('train_stream_rate_ratio')}x "
+                        "in-core (< 0.85) with BENCH_STRICT_EXTRAS=1")
+                if not stream_leg.get("train_stream_rss_ok"):
+                    failures.append(
+                        "streamed training peak pipeline RSS "
+                        f"({stream_leg.get('train_stream_peak_pipeline_mb')}"
+                        " MB) exceeds the in-core leg by >10% with "
                         "BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and \
                 recompile_watch is not None:

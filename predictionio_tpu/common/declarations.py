@@ -97,6 +97,18 @@ ENV_VARS: Dict[str, str] = {
     "PIO_READ_STAGE":
         "async per-chunk device_put staging during overlapped reads "
         "(default 1; 0 = stage nothing)",
+    "PIO_TRAIN_STREAM":
+        "out-of-core training read: auto (default — stream wherever "
+        "staging engages) | on | off (the bit-compatible in-core path); "
+        "streamed trains release host chunks as they stage, so peak "
+        "host memory is O(chunk) not O(dataset), with bit-identical "
+        "factors",
+    "PIO_SYNTHETIC_EVENTS":
+        "train on N deterministic synthetic zipfian ratings instead of "
+        "the event store (`pio train --synthetic N`; seeded generator, "
+        "no dataset download)",
+    "PIO_SYNTHETIC_SEED":
+        "seed for the synthetic rating generator (default 7)",
     # ------------------------------------------------------- ALS kernels
     "PIO_ALS_KERNEL":
         "ALS trainer kernel: hybrid (default) | csrb | scan",
@@ -376,6 +388,13 @@ METRICS: Dict[str, str] = {
         "device memory_stats peak bytes (collector)",
     "pio_live_arrays": "live jax array count at scrape (collector)",
     "pio_live_array_bytes": "live jax array bytes at scrape (collector)",
+    "pio_host_rss_bytes":
+        "host process resident-set size from /proc/self/status "
+        "(collector; absent off-Linux — the out-of-core O(chunk) "
+        "claim's gauge)",
+    "pio_host_rss_peak_bytes":
+        "host process peak RSS (VmHWM) from /proc/self/status "
+        "(collector; absent off-Linux)",
     "pio_compile_cache_entries":
         "persistent compile-cache entry count (collector)",
     "pio_compile_cache_bytes":

@@ -404,6 +404,88 @@ def _device_stats() -> List[Dict[str, Any]]:
     return out
 
 
+def host_memory_stats() -> Dict[str, Optional[int]]:
+    """Host process memory from ``/proc``: resident set (VmRSS), its
+    high-water mark (VmHWM) and the machine total (MemTotal) — the
+    observability the out-of-core training claim rests on (peak host
+    RSS must stay O(chunk), not O(dataset)). Gracefully absent (None
+    values) where ``/proc`` does not exist, per the KNOWN_ISSUES #8
+    pattern for platform-dependent gauges. NOTE: on CPU jax backends,
+    device arrays ARE host memory and therefore count in RSS — subtract
+    the live-array census when judging the pipeline's own footprint
+    (KNOWN_ISSUES #14)."""
+    out: Dict[str, Optional[int]] = {
+        "rssBytes": None, "peakRssBytes": None, "memTotalBytes": None}
+    try:
+        with open("/proc/self/status", encoding="ascii",
+                  errors="replace") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rssBytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    out["peakRssBytes"] = int(line.split()[1]) * 1024
+    except OSError:
+        return out
+    try:
+        with open("/proc/meminfo", encoding="ascii",
+                  errors="replace") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    out["memTotalBytes"] = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
+    return out
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident-set size, or None where /proc is unavailable."""
+    return host_memory_stats()["rssBytes"]
+
+
+class RssWatcher:
+    """Sampling thread for peak-memory claims (the bench train-stream
+    leg and the 1 B-rating soak): records the peak RSS and the peak of
+    RSS minus live jax array bytes — the latter is what isolates the
+    HOST pipeline's footprint on CPU backends, where device buffers
+    live in the same RSS (KNOWN_ISSUES #14). Timing uses sleep
+    intervals only; no timed region is claimed, so the KNOWN_ISSUES #3
+    host-transfer rule does not apply here."""
+
+    def __init__(self, interval_s: float = 0.05):
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.peak_rss = 0
+        self.peak_pipeline = 0   # max over samples of rss - live_bytes
+        self.samples = 0
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            st = host_memory_stats()
+            rss = st["rssBytes"]
+            if rss is not None:
+                self.samples += 1
+                if rss > self.peak_rss:
+                    self.peak_rss = rss
+                live = _live_array_stats()["bytes"]
+                pipeline = max(rss - live, 0)
+                if pipeline > self.peak_pipeline:
+                    self.peak_pipeline = pipeline
+            self._stop.wait(self._interval)
+
+    def __enter__(self) -> "RssWatcher":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pio-rss-watch")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
 def _live_array_stats() -> Dict[str, int]:
     jax = _jax_module()
     if jax is None or not hasattr(jax, "live_arrays"):
@@ -442,6 +524,14 @@ class _DeviceCollector:
         lines.append(f"pio_live_arrays {live['count']}")
         lines.append("# TYPE pio_live_array_bytes gauge")
         lines.append(f"pio_live_array_bytes {live['bytes']}")
+        host = host_memory_stats()
+        if host["rssBytes"] is not None:
+            lines.append("# TYPE pio_host_rss_bytes gauge")
+            lines.append(f"pio_host_rss_bytes {host['rssBytes']}")
+        if host["peakRssBytes"] is not None:
+            lines.append("# TYPE pio_host_rss_peak_bytes gauge")
+            lines.append(
+                f"pio_host_rss_peak_bytes {host['peakRssBytes']}")
         cache = compile_cache_stats()
         lines.append("# TYPE pio_compile_cache_entries gauge")
         lines.append(f"pio_compile_cache_entries {cache['entries']}")
@@ -537,6 +627,7 @@ def debug_snapshot() -> Dict[str, Any]:
         "foldin": foldin_state,
         "devices": _device_stats(),
         "liveArrays": _live_array_stats(),
+        "hostMemory": host_memory_stats(),
         "compileCache": {"dir": compile_cache_dir(),
                          **compile_cache_stats()},
         "breakers": breakers,

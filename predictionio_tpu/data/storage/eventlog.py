@@ -1559,16 +1559,41 @@ class EventlogEvents(Events):
         def chunks() -> Iterator[Dict[str, np.ndarray]]:
             n_threads = _read_thread_count(read_threads)
             if n_threads > 1 and len(seqs) > 1:
+                from collections import deque
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(
                         max_workers=min(n_threads, len(seqs)),
                         thread_name_prefix="pio-read") as pool_:
-                    futs = [pool_.submit(
-                        self._decode_chunk_columns, sh, seq, ev_codes,
-                        et_code, tt_code, tomb_by_seq.get(seq),
-                        rating_property) for seq in seqs]
-                    for f in futs:     # seq order preserved for parity
-                        yield f.result()
+                    # BOUNDED decode-ahead: at most ~2x the worker count
+                    # of chunks may be decoded (or decoding) ahead of
+                    # the consumer. Submitting every future up front —
+                    # the pre-stream behavior — let a slow consumer
+                    # accumulate O(dataset) of decoded columns in the
+                    # completed futures; the sliding window caps
+                    # buffered host chunks at O(threads * chunk), which
+                    # is what makes the out-of-core train path's
+                    # O(chunk) host claim hold through this layer.
+                    # Seq order is preserved (popleft), so parity with
+                    # the serial path is unchanged.
+                    window = max(2 * min(n_threads, len(seqs)), 2)
+                    pending: deque = deque()
+                    it = iter(seqs)
+                    for seq in it:
+                        pending.append(pool_.submit(
+                            self._decode_chunk_columns, sh, seq,
+                            ev_codes, et_code, tt_code,
+                            tomb_by_seq.get(seq), rating_property))
+                        if len(pending) >= window:
+                            break
+                    while pending:
+                        out = pending.popleft().result()
+                        nxt = next(it, None)
+                        if nxt is not None:
+                            pending.append(pool_.submit(
+                                self._decode_chunk_columns, sh, nxt,
+                                ev_codes, et_code, tt_code,
+                                tomb_by_seq.get(nxt), rating_property))
+                        yield out
             else:
                 for seq in seqs:
                     yield self._decode_chunk_columns(

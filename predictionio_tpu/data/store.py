@@ -200,24 +200,38 @@ class ColumnarEvents:
     entity_idx / target_idx are dense int32 via the included BiMaps;
     `rating` is the chosen numeric property (NaN when absent);
     `event_name_idx` indexes into `event_names`.
+
+    Under the STREAMED training read (``columnar_from_stream(stream=
+    True)`` — the out-of-core `pio train` path) the host arrays are
+    ``None``: the encoded columns exist only as the device-resident
+    ``staged`` mirrors (ops/staging.StagedColumns), host peak memory
+    stays O(chunk), and ``stream_digest`` carries the incremental
+    content fingerprint the layout cache keys on instead of hashing
+    host arrays that no longer exist.
     """
     entity_ids: BiMap            # str -> int32 (e.g. users)
     target_ids: BiMap            # str -> int32 (e.g. items)
     event_names: List[str]
-    entity_idx: np.ndarray       # (n,) int32
-    target_idx: np.ndarray       # (n,) int32, -1 when no target entity
-    event_name_idx: np.ndarray   # (n,) int32
-    rating: np.ndarray           # (n,) float32, NaN when property absent
-    event_time_ms: np.ndarray    # (n,) int64 epoch millis
+    entity_idx: Optional[np.ndarray]       # (n,) int32; None when streamed
+    target_idx: Optional[np.ndarray]       # (n,) int32, -1 = no target
+    event_name_idx: Optional[np.ndarray]   # (n,) int32
+    rating: Optional[np.ndarray]     # (n,) float32, NaN where absent
+    event_time_ms: Optional[np.ndarray]    # (n,) int64 epoch millis
     #: optional device-resident mirrors of the encoded arrays
     #: (ops/staging.StagedColumns), populated by the overlapped read path
     #: when the caller asked for staging — value-identical to the host
     #: arrays above, already in HBM so the ALS layout skips its transfer
     staged: Optional[object] = None
+    #: blake2b digest of the raw chunk columns (streamed reads only) —
+    #: the content fingerprint of a dataset whose host copy was never
+    #: materialized
+    stream_digest: Optional[bytes] = None
 
     @property
     def n(self) -> int:
-        return int(self.entity_idx.shape[0])
+        if self.entity_idx is not None:
+            return int(self.entity_idx.shape[0])
+        return int(self.staged.n) if self.staged is not None else 0
 
 
 def _columnar_from_codes(cols: Dict[str, object],
@@ -312,26 +326,97 @@ def _overlap_enabled() -> bool:
     return os.environ.get("PIO_READ_OVERLAP", "1") != "0"
 
 
-def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
-                            entity_type, target_entity_type, rating_property,
-                            entity_vocab, target_vocab, stage, timings):
-    """Overlapped bulk read: consume per-chunk column arrays as decode
-    workers finish, folding the vocab-presence pass (and, when staging is
-    on, the host→HBM transfer of each chunk) into the decode wall-clock
-    instead of after it. Byte-identical output to the non-streamed path.
+def train_stream_mode() -> str:
+    """``PIO_TRAIN_STREAM`` — the out-of-core training knob:
+
+    - ``auto`` (default): stream when the event source exposes a chunk
+      stream AND device staging is available (jax importable,
+      ``PIO_READ_STAGE`` not 0); the warm-layout-cache veto lives in the
+      template layer (als_algorithm.stream_wanted);
+    - ``on``: force the streamed path (still requires staging — without
+      a device there is nowhere for the columns to live);
+    - ``off``: the exact in-core path, bit-compatible with pre-stream
+      releases (host arrays retained, same read/encode/layout code).
+    """
+    import os
+    mode = os.environ.get("PIO_TRAIN_STREAM", "auto").lower()
+    return mode if mode in ("auto", "on", "off") else "auto"
+
+
+def resolve_train_stream(chunk_src=None) -> bool:
+    """Resolve :func:`train_stream_mode` against a chunk source (an
+    events DAO with ``read_columns_streamed``, a synthetic ChunkSource,
+    or None = capability-only). Returns whether the TRAINING read runs
+    the O(chunk)-host streamed pipeline."""
+    mode = train_stream_mode()
+    if mode == "off":
+        return False
+    from predictionio_tpu.ops.staging import staging_available
+    if not staging_available():
+        if mode == "on":
+            import logging
+            logging.getLogger(__name__).warning(
+                "PIO_TRAIN_STREAM=on but device staging is unavailable "
+                "(PIO_READ_STAGE=0 or no jax); training in-core")
+        return False
+    if chunk_src is not None and not (
+            hasattr(chunk_src, "read_columns_streamed")
+            or hasattr(chunk_src, "chunks")):
+        return False
+    return True
+
+
+def columnar_from_stream(
+    pool: List[str],
+    chunks,
+    event_names: Optional[Sequence[str]] = None,
+    entity_vocab: Optional[BiMap] = None,
+    target_vocab: Optional[BiMap] = None,
+    stage: bool = True,
+    stream: bool = False,
+    timings: Optional[Dict[str, float]] = None,
+) -> ColumnarEvents:
+    """Consume a columnar chunk stream into vocab-encoded columns.
+
+    The shared body of the overlapped bulk read: per-chunk vocab
+    presence (and, when staging is on, the async host→HBM copy) folds
+    into the chunk-decode wall-clock. Two retention modes:
+
+    - ``stream=False`` (default): host chunks are retained and
+      concatenated — byte-identical to the non-streamed read; the
+      in-core path;
+    - ``stream=True``: host chunks are RELEASED as soon as their raw
+      codes are staged to the device, so peak host memory is O(chunk) +
+      O(vocab) instead of O(dataset). The encoded columns exist only as
+      ``ColumnarEvents.staged`` device mirrors (value-identical to what
+      the in-core path would have built — the device remap runs the
+      same integer ops on the same inputs), and ``stream_digest``
+      carries an incremental blake2b over the raw chunk columns so the
+      layout cache can still recognize an unchanged dataset. Requires
+      grow-both vocabs and available staging; falls back to in-core
+      retention otherwise (a fixed vocab can drop rows, which needs the
+      host columns).
 
     Timing split: read_io = time spent waiting on chunk decode;
-    read_encode = per-chunk accumulation + the final dense remap."""
-    pool, chunks = events_dao.read_columns_streamed(
-        app_id, channel_id, event_names=event_names,
-        entity_type=entity_type, target_entity_type=target_entity_type,
-        rating_property=rating_property)
+    read_encode = per-chunk accumulation + the final dense remap.
+    """
+    import hashlib
+
     stager = None
-    if stage and entity_vocab is None and target_vocab is None:
+    grow_both = entity_vocab is None and target_vocab is None
+    if (stage or stream) and grow_both:
         from predictionio_tpu.ops import staging as _staging
         if _staging.staging_available():
             stager = _staging.ColumnStager()
+    stream = stream and stager is not None
+    # the raw-chunk digest is computed in BOTH retention modes (cheap
+    # next to decode): it is the MODE-AGNOSTIC content fingerprint, so
+    # a layout cached by a streamed train is hit by a later in-core
+    # retrain of the unchanged store and vice versa
+    digest = hashlib.blake2b(digest_size=16) if grow_both else None
     parts = []
+    n_rows = 0
+    name_codes: set = set()
     e_present = (np.zeros(len(pool), dtype=bool)
                  if entity_vocab is None else None)
     t_present = (np.zeros(len(pool), dtype=bool)
@@ -341,7 +426,7 @@ def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
     for ch in chunks:
         now = _time.perf_counter()
         io_s += now - t_mark
-        parts.append(ch)
+        n_rows += int(ch["entity_code"].shape[0])
         # vocab-presence accumulates per chunk WHILE later chunks decode
         if e_present is not None:
             ec = ch["entity_code"]
@@ -351,8 +436,37 @@ def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
             t_present[tc[tc >= 0]] = True
         if stager is not None:
             stager.add(ch)      # async host→HBM copy rides the decode
+        if digest is not None:
+            for key in ("entity_code", "target_code", "event_code",
+                        "rating", "time_ms"):
+                digest.update(np.ascontiguousarray(ch[key]).view(np.uint8))
+        if stream:
+            # the host chunk dies here: digest + event-name census are
+            # the only host state that outlives it
+            if event_names is None:
+                name_codes.update(np.unique(ch["event_code"]).tolist())
+        else:
+            parts.append(ch)
         t_mark = _time.perf_counter()
     t1 = _time.perf_counter()
+
+    presence = {}
+    if e_present is not None:
+        presence["entity"] = e_present
+    if t_present is not None:
+        presence["target"] = t_present
+
+    if stream:
+        luts: Dict[str, object] = {}
+        out = _stream_vocabs(pool, presence, sorted(name_codes),
+                             event_names, luts_out=luts)
+        out.stream_digest = digest.digest()
+        out.staged = stager.finalize(luts["e_lut"], luts["t_lut"],
+                                     luts["name_lut"])
+        if timings is not None:
+            timings["read_io"] = io_s
+            timings["read_encode"] = _time.perf_counter() - t1
+        return out
 
     def cat(key, dtype):
         xs = [p[key] for p in parts]
@@ -366,14 +480,11 @@ def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
         "rating": cat("rating", np.float32),
         "time_ms": cat("time_ms", np.int64),
     }
-    presence = {}
-    if e_present is not None:
-        presence["entity"] = e_present
-    if t_present is not None:
-        presence["target"] = t_present
-    luts: Dict[str, object] = {}
+    luts = {}
     out = _columnar_from_codes(cols, event_names, entity_vocab, target_vocab,
                                presence=presence, luts_out=luts)
+    if digest is not None:
+        out.stream_digest = digest.digest()
     if stager is not None and luts.get("kept_all"):
         out.staged = stager.finalize(luts["e_lut"], luts["t_lut"],
                                      luts["name_lut"])
@@ -381,6 +492,62 @@ def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
         timings["read_io"] = io_s
         timings["read_encode"] = _time.perf_counter() - t1
     return out
+
+
+def _stream_vocabs(pool: List[str], presence: Dict[str, np.ndarray],
+                   name_codes: Sequence[int],
+                   event_names: Optional[Sequence[str]],
+                   luts_out: Dict[str, object]) -> ColumnarEvents:
+    """Vocabs + dense LUTs from presence bitmaps alone (the streamed
+    read's encode: no row arrays exist on host). The vocab-id
+    assignment — dictionary-code order over present codes — is exactly
+    ``_columnar_from_codes.dense``'s grow branch, so streamed and
+    in-core reads of the same store build identical BiMaps and the
+    device remap (ops/staging.finalize) reproduces the host encode
+    value for value."""
+    def dense(present):
+        used = np.nonzero(present)[0]
+        lut = np.full(len(pool), -1, np.int32)
+        lut[used] = np.arange(used.size, dtype=np.int32)
+        vocab = BiMap({pool[int(c)]: int(lut[c]) for c in used.tolist()})
+        return vocab, lut
+
+    e_vocab, e_lut = dense(presence["entity"])
+    t_vocab, t_lut = dense(presence["target"])
+    if event_names:
+        name_order = list(event_names)
+    else:
+        name_order = [pool[int(c)] for c in name_codes]
+    name_lut = np.full(len(pool) + 1, -1, np.int32)
+    for i, n in enumerate(name_order):
+        try:
+            name_lut[pool.index(n)] = i
+        except ValueError:
+            pass
+    luts_out.update(e_lut=e_lut, t_lut=t_lut, name_lut=name_lut,
+                    kept_all=True)
+    return ColumnarEvents(
+        entity_ids=e_vocab, target_ids=t_vocab, event_names=name_order,
+        entity_idx=None, target_idx=None, event_name_idx=None,
+        rating=None, event_time_ms=None)
+
+
+def _find_columnar_streamed(events_dao, app_id, channel_id, event_names,
+                            entity_type, target_entity_type, rating_property,
+                            entity_vocab, target_vocab, stage, timings,
+                            stream=False):
+    """Overlapped bulk read: consume per-chunk column arrays as decode
+    workers finish (see :func:`columnar_from_stream` for the retention
+    modes; ``stream=False`` output is byte-identical to the
+    non-streamed path)."""
+    pool, chunks = events_dao.read_columns_streamed(
+        app_id, channel_id, event_names=event_names,
+        entity_type=entity_type, target_entity_type=target_entity_type,
+        rating_property=rating_property)
+    return columnar_from_stream(
+        pool, chunks, event_names=event_names, entity_vocab=entity_vocab,
+        target_vocab=target_vocab, stage=stage, stream=stream,
+        timings=timings)
 
 
 def find_columnar(
@@ -395,6 +562,7 @@ def find_columnar(
     storage: Optional[Storage] = None,
     timings: Optional[Dict[str, float]] = None,
     stage: bool = False,
+    stream: bool = False,
 ) -> ColumnarEvents:
     """Single-pass events → columnar buffers + vocabs.
 
@@ -408,6 +576,14 @@ def find_columnar(
     `device_put` while later chunks are still decoding, so the host→HBM
     COO transfer overlaps the read instead of following it. Only engaged
     when both vocabs grow (no rows dropped) and `PIO_READ_STAGE` != 0.
+
+    `stream=True` (the out-of-core `pio train` path, PIO_TRAIN_STREAM)
+    goes further: host chunks are released the moment their raw codes
+    are staged, so peak host memory is O(chunk) + O(vocab) and the
+    returned ColumnarEvents carries ONLY the device mirrors (host array
+    fields are None; `stream_digest` fingerprints the dataset). Same
+    engagement preconditions as staging; falls back to the retained
+    in-core read when they don't hold.
 
     This replaces the reference's full Spark job for `BiMap.stringInt`
     (BiMap.scala:96-128) plus the per-template `.map`/`.filter` RDD chains:
@@ -429,7 +605,7 @@ def find_columnar(
         return _find_columnar_streamed(
             events_dao, app_id, channel_id, event_names, entity_type,
             target_entity_type, rating_property, entity_vocab, target_vocab,
-            stage, timings)
+            stage, timings, stream=stream)
     if hasattr(events_dao, "read_columns"):
         app_id, channel_id = _resolve_app(app_name, channel_name, storage)
         t0 = _time.perf_counter()

@@ -90,11 +90,26 @@ _BIG_LAYOUT_CACHE: list = []   # [(meta, digest, ALSData)]
 
 
 def _layout_meta(td, use_mesh: bool):
-    return (use_mesh, td.n, len(td.user_vocab), len(td.item_vocab))
+    # "raw" fingerprints hash the raw chunk columns (streamed AND
+    # in-core reads of a chunked store — mode-agnostic, so the two
+    # share cache entries); "enc" hashes the encoded host arrays (reads
+    # with no chunk stream). The kind bit keeps the two digest
+    # keyspaces from ever comparing.
+    kind = "raw" if getattr(td, "_stream_digest", None) else "enc"
+    return (use_mesh, kind, td.n,
+            len(td.user_vocab), len(td.item_vocab))
 
 
 def _layout_crc(td) -> bytes:
     import hashlib
+    digest = getattr(td, "_stream_digest", None)
+    if digest:
+        # incremental digest over the raw chunk columns, computed
+        # during the scan in both retention modes (same collision
+        # bound as the encoded hash; under the streamed read the host
+        # COO never existed, so this is also the ONLY possible
+        # fingerprint there)
+        return digest
     h = hashlib.blake2b(digest_size=16)
     for a in (td.user_idx, td.item_idx, td.rating):
         h.update(np.ascontiguousarray(a).view(np.uint8))
@@ -151,6 +166,25 @@ def staging_wanted() -> bool:
     return not (als._layout_cache_enabled() and _BIG_LAYOUT_CACHE)
 
 
+def stream_wanted(ctx=None) -> bool:
+    """Should the TRAINING read run the O(chunk)-host streamed pipeline
+    (PIO_TRAIN_STREAM)? `auto` resolves to the streamed path wherever
+    staging would engage; it declines a warm retrain (a populated
+    big-layout cache means the in-core read's fingerprint will hit
+    without paying any transfer), while an explicit `on` streams
+    unconditionally — the digest-keyed cache still works there, it just
+    costs the staged copy to find out."""
+    from predictionio_tpu.data import store as _store
+    mode = _store.train_stream_mode()
+    if mode == "off":
+        return False
+    if not _store.resolve_train_stream():
+        return False
+    if mode == "on":
+        return True
+    return staging_wanted()
+
+
 def _ensure_layout(ctx, td, use_mesh: bool):
     """The device-side COO layout for one TrainingData, through both cache
     tiers (train's "layout" phase body, shared with prepare_layout).
@@ -185,21 +219,43 @@ def _ensure_layout(ctx, td, use_mesh: bool):
         # transiently double retained HBM
         _BIG_LAYOUT_CACHE.clear()
         als._HYBRID_CACHE.clear()
-    # the overlapped read may have pre-staged the encoded COO in HBM
-    # (ops/staging.py rides it on the TrainingData); the staged arrays are
-    # value-identical to the host columns, so prepare_ratings consumes
-    # them directly and skips its own host shipping
-    staged = getattr(td, "_staged_coo", None) if not use_mesh else None
-    if staged is not None and int(staged[0].shape[0]) == td.n:
-        u_in, i_in, r_in = staged
+    if td.streamed:
+        # out-of-core read: the device mirrors are the ONLY copy. The
+        # layout consumes (and, off-CPU, DONATES) them — the staged
+        # buffers are dead after this, so drop the reference either way
+        u_in, i_in, r_in = td._staged_coo
+        if use_mesh:
+            from predictionio_tpu.parallel import als_dist
+            data = als_dist.shard_staged_coo(
+                ctx.mesh, u_in, i_in, r_in,
+                n_users=len(td.user_vocab), n_items=len(td.item_vocab))
+        else:
+            data = als.prepare_ratings(
+                u_in, i_in, r_in,
+                n_users=len(td.user_vocab), n_items=len(td.item_vocab),
+                device=True, donate=True)
+        del u_in, i_in, r_in
+        td._staged_coo = None
     else:
-        u_in, i_in, r_in = td.user_idx, td.item_idx, td.rating
-    data = als.prepare_ratings(
-        u_in, i_in, r_in,
-        n_users=len(td.user_vocab), n_items=len(td.item_vocab),
-        # single-device: sort/pad in HBM; mesh path re-partitions on host
-        device=not use_mesh)
-    if not isinstance(data.by_user.self_idx, np.ndarray):
+        # the overlapped read may have pre-staged the encoded COO in HBM
+        # (ops/staging.py rides it on the TrainingData); the staged
+        # arrays are value-identical to the host columns, so
+        # prepare_ratings consumes them directly and skips its own host
+        # shipping
+        staged = getattr(td, "_staged_coo", None) if not use_mesh else None
+        if staged is not None and int(staged[0].shape[0]) == td.n:
+            u_in, i_in, r_in = staged
+        else:
+            u_in, i_in, r_in = td.user_idx, td.item_idx, td.rating
+        data = als.prepare_ratings(
+            u_in, i_in, r_in,
+            n_users=len(td.user_vocab), n_items=len(td.item_vocab),
+            # single-device: sort/pad in HBM; mesh path re-partitions on
+            # host
+            device=not use_mesh)
+    by_user = getattr(data, "by_user", None)   # PreshardedData barriers
+    if by_user is not None \
+            and not isinstance(by_user.self_idx, np.ndarray):
         # tunneled platforms (axon) can return from block_until_ready
         # before results land; fetching one element forces the in-HBM
         # sort so the layout phase owns its wall-clock instead of

@@ -47,16 +47,36 @@ class DataSourceParams(Params):
 
 @dataclass
 class TrainingData(SanityCheck):
-    """Columnar, vocab-encoded ratings (the RDD[Rating] analogue)."""
-    user_idx: np.ndarray     # (n,) int32
-    item_idx: np.ndarray     # (n,) int32
-    rating: np.ndarray       # (n,) float32
+    """Columnar, vocab-encoded ratings (the RDD[Rating] analogue).
+
+    Under the STREAMED training read (PIO_TRAIN_STREAM, out-of-core
+    `pio train`) the host arrays are ``None``: the encoded COO exists
+    only as the device-resident ``_staged_coo`` triple (value-identical
+    to what the host arrays would hold), so peak host memory stays
+    O(chunk). Everything that needs host rows (eval folds, the content
+    fingerprint) either runs in-core or uses the stream digest."""
+    user_idx: Optional[np.ndarray]     # (n,) int32; None when streamed
+    item_idx: Optional[np.ndarray]     # (n,) int32
+    rating: Optional[np.ndarray]       # (n,) float32
     user_vocab: BiMap
     item_vocab: BiMap
 
     @property
     def n(self) -> int:
-        return int(self.user_idx.shape[0])
+        if self.user_idx is not None:
+            return int(self.user_idx.shape[0])
+        # streamed: the explicit count survives the layout CONSUMING
+        # (donating) the staged buffers — td.n must not change when the
+        # device COO is handed to the trainer
+        n = getattr(self, "_n", None)
+        if n is not None:
+            return int(n)
+        staged = getattr(self, "_staged_coo", None)
+        return int(staged[0].shape[0]) if staged is not None else 0
+
+    @property
+    def streamed(self) -> bool:
+        return self.user_idx is None
 
     def sanity_check(self) -> None:
         if self.n == 0:
@@ -65,6 +85,8 @@ class TrainingData(SanityCheck):
                 "appName correct?")
 
     def __str__(self) -> str:
+        if self.user_idx is None:
+            return f"ratings: [{self.n}] (streamed; device-resident COO)"
         return (f"ratings: [{self.n}] "
                 f"({self.n and list(zip(self.user_idx[:2], self.item_idx[:2], self.rating[:2]))}...)")
 
@@ -80,11 +102,44 @@ def training_data_from_columnar(col) -> TrainingData:
     on device and the resulting (user, item, rating) device COO rides the
     TrainingData as `_staged_coo`, letting the ALS layout skip its own
     host→HBM transfer. The host arrays below stay the source of truth
-    (sanity checks, fingerprints, eval folds all use them)."""
+    (sanity checks, fingerprints, eval folds all use them) — except
+    under the STREAMED read (`col.entity_idx is None`), where the
+    device mirrors are the only copy: the buy mapping and the
+    missing-rating check then run on device (one scalar host transfer
+    for the error check) and the TrainingData carries no host COO."""
+    buy_code = (col.event_names.index("buy")
+                if "buy" in col.event_names else None)
+    if col.entity_idx is None:
+        # streamed read: device-only columns (O(chunk) host contract)
+        staged = col.staged
+        if staged is None:
+            # empty stream: nothing was staged; the standard
+            # empty-ratings error fires at sanity_check/train
+            td = TrainingData(
+                user_idx=None, item_idx=None, rating=None,
+                user_vocab=col.entity_ids, item_vocab=col.target_ids)
+            td._n = 0
+            return td
+        import jax
+        import jax.numpy as jnp
+
+        u_d, i_d, r_d = staged.training_view(buy_code, BUY_RATING)
+        bad = int(jax.device_get(jnp.isnan(r_d).sum()))
+        if bad:
+            raise ValueError(
+                f"{bad} rate event(s) have no numeric 'rating' property — "
+                "cannot convert to Rating (DataSource.scala:62-68 "
+                "behavior)")
+        td = TrainingData(
+            user_idx=None, item_idx=None, rating=None,
+            user_vocab=col.entity_ids, item_vocab=col.target_ids,
+        )
+        td._n = int(u_d.shape[0])
+        td._staged_coo = (u_d, i_d, r_d)
+        td._stream_digest = col.stream_digest
+        return td
     rating = col.rating.copy()
-    buy_code = None
-    if "buy" in col.event_names:
-        buy_code = col.event_names.index("buy")
+    if buy_code is not None:
         rating[col.event_name_idx == buy_code] = BUY_RATING
     if np.isnan(rating).any():
         bad = int(np.isnan(rating).sum())
@@ -95,6 +150,12 @@ def training_data_from_columnar(col) -> TrainingData:
         user_idx=col.entity_idx, item_idx=col.target_idx, rating=rating,
         user_vocab=col.entity_ids, item_vocab=col.target_ids,
     )
+    # the raw-chunk digest rides in-core reads too: it is the
+    # MODE-AGNOSTIC layout-cache fingerprint, so streamed and in-core
+    # trains of the same store share cache entries
+    digest = getattr(col, "stream_digest", None)
+    if digest is not None:
+        td._stream_digest = digest
     staged = getattr(col, "staged", None)
     if staged is not None and staged.n == td.n:
         td._staged_coo = staged.training_view(buy_code, BUY_RATING)
@@ -107,10 +168,19 @@ class DataSource(BaseDataSource):
     def __init__(self, params: DataSourceParams):
         self.dsp = params
 
-    def _get_ratings(self, ctx,
-                     entity_vocab=None, target_vocab=None) -> TrainingData:
+    def _get_ratings(self, ctx, entity_vocab=None, target_vocab=None,
+                     stream_ok: bool = False) -> TrainingData:
         timings: Dict[str, float] = {}
+        from predictionio_tpu.data import synthetic
         from predictionio_tpu.models.recommendation import als_algorithm
+        syn = synthetic.env_config() if stream_ok else None
+        if syn is not None:
+            # `pio train --synthetic N`: a seeded zipfian generator
+            # replaces the event-store read outright (no dataset
+            # download, O(chunk) host under PIO_TRAIN_STREAM)
+            return synthetic.training_data(
+                syn.n_events, seed=syn.seed, n_users=syn.n_users,
+                n_items=syn.n_items, chunk=syn.chunk)
         col = store.find_columnar(
             self.dsp.appName,
             entity_type="user",
@@ -125,6 +195,9 @@ class DataSource(BaseDataSource):
             # when a layout rebuild is plausible (a warm retrain whose
             # content-fingerprint cache will hit must not pay the transfer)
             stage=als_algorithm.staging_wanted(),
+            # out-of-core: release host chunks once staged (training
+            # reads only — eval folds need the host rows)
+            stream=stream_ok and als_algorithm.stream_wanted(ctx),
         )
         # sub-phase visibility: store scan vs vocab-encode inside "read"
         # (note_phase also mirrors into the metrics registry)
@@ -138,7 +211,7 @@ class DataSource(BaseDataSource):
         return training_data_from_columnar(col)
 
     def read_training(self, ctx) -> TrainingData:
-        return self._get_ratings(ctx)
+        return self._get_ratings(ctx, stream_ok=True)
 
     def read_eval(self, ctx):
         """k-fold split by rating index % k (readEval, DataSource.scala:82-107):

@@ -119,7 +119,14 @@ class ColumnStager:
         t_lut_d = jax.device_put(np.asarray(t_lut, np.int32))
         n_lut_d = jax.device_put(np.asarray(name_lut, np.int32))
         es, ts, ns, rs = [], [], [], []
-        for ec, tc, nc, r in self._chunks:
+        # consume the chunk list front-to-back and DROP each raw buffer
+        # as its remap is enqueued: at any moment at most one chunk's
+        # raw codes coexist with its remapped twin, so the streamed
+        # train path's device peak stays ~1x the COO (+1 chunk) rather
+        # than 2x while the old list held every raw buffer alive
+        self._chunks.reverse()
+        while self._chunks:
+            ec, tc, nc, r = self._chunks.pop()
             es.append(jnp.where(ec >= 0, e_lut_d[jnp.maximum(ec, 0)],
                                 jnp.int32(-1)))
             ts.append(jnp.where(tc >= 0, t_lut_d[jnp.maximum(tc, 0)],
@@ -130,7 +137,6 @@ class ColumnStager:
             ns.append(jnp.where(nc >= 0, n_lut_d[jnp.maximum(nc, 0)],
                                 jnp.int32(-1)))
             rs.append(r)
-        self._chunks = []   # free the raw staging buffers after remap
         one = len(es) == 1
         out = StagedColumns(
             entity_idx=es[0] if one else jnp.concatenate(es),

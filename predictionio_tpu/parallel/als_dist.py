@@ -29,7 +29,8 @@ single-device trainers — snapshots are canonical (n_users, rank) /
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from functools import partial
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -166,6 +167,171 @@ def _shard_side(side: COOSide, n_dev: int, chunk: int) -> ShardedSide:
     )
 
 
+@dataclass
+class PreshardedData:
+    """Sharded COO assembled ON DEVICE from the streamed train path
+    (``shard_staged_coo``): both orientations live as globally-sharded
+    jax arrays, ``pos`` is the identity (contiguous block deal), and no
+    host copy of the dataset ever existed. ``train_explicit_sharded`` /
+    ``train_implicit_sharded`` accept it in place of :class:`ALSData`
+    (hybrid degrades to csrb — its dense-hot prep is host-side)."""
+    su: ShardedSide
+    si: ShardedSide
+    n_users: int
+    n_items: int
+    nnz: int
+
+
+class _DeviceRouter:
+    """Bounded host→device row routing: rows append to a per-device
+    host buffer; a buffer exceeding ``flush_rows`` ships to ITS device
+    as one slab and the host copy dies — host residency stays
+    O(route slice + n_dev * flush_rows) regardless of dataset size."""
+
+    def __init__(self, n_dev: int, devices, flush_rows: int):
+        self._devices = devices
+        self._flush = max(int(flush_rows), 1)
+        self._host = {d: [] for d in range(n_dev)}
+        self._shipped = {d: [] for d in range(n_dev)}
+
+    def add(self, dev_of: np.ndarray, cols) -> None:
+        import jax
+
+        for d in np.unique(dev_of).tolist():
+            m = dev_of == d
+            self._host[d].append(tuple(c[m] for c in cols))
+            if sum(p[0].shape[0] for p in self._host[d]) >= self._flush:
+                slab = tuple(
+                    np.concatenate([p[k] for p in self._host[d]])
+                    for k in range(len(cols)))
+                self._shipped[d].append(tuple(
+                    jax.device_put(a, self._devices[d]) for a in slab))
+                self._host[d] = []
+
+    def device_columns(self, d: int, dtypes):
+        """Everything routed to device ``d`` as one concatenated column
+        tuple ON that device (empty columns when nothing routed)."""
+        import jax
+
+        slabs = list(self._shipped.pop(d))
+        host = self._host.pop(d)
+        if host:
+            slab = tuple(np.concatenate([p[k] for p in host])
+                         for k in range(len(dtypes)))
+            slabs.append(tuple(jax.device_put(a, self._devices[d])
+                               for a in slab))
+        cols = []
+        for k, dt in enumerate(dtypes):
+            parts = [s[k] for s in slabs]
+            if not parts:
+                cols.append(jax.device_put(np.empty(0, dt),
+                                           self._devices[d]))
+            elif len(parts) == 1:
+                cols.append(parts[0])
+            else:
+                cols.append(jnp.concatenate(parts))
+        return tuple(cols)
+
+
+@partial(jax.jit, static_argnames=("rows_dev", "nnz_dev"))
+def _local_side_layout(s_local, other, rating, rows_dev: int,
+                       nnz_dev: int):
+    """One device's block: sort its (already block-local) rows by local
+    row id — stable, so within-row entry order is arrival order, the
+    same order the in-core layout preserves — pad to the common
+    per-device width with the dummy row ``rows_dev``, and derive the
+    per-slot counts. Mirrors ``_shard_side``'s per-device output
+    exactly (padding entries carry the dummy row, weight 0)."""
+    extra = nnz_dev - s_local.shape[0]
+    s_local = jnp.pad(s_local, (0, extra), constant_values=rows_dev)
+    other = jnp.pad(other, (0, extra))
+    rating = jnp.pad(rating, (0, extra))
+    s, o, r = lax.sort((s_local, other, rating), num_keys=1)
+    counts = jnp.bincount(s_local, length=rows_dev + 1
+                          )[:rows_dev].astype(jnp.int32)
+    return s, o, r, counts
+
+
+def shard_staged_coo(mesh: Mesh, u_dev, i_dev, r_dev, n_users: int,
+                     n_items: int, chunk: int = 1 << 16,
+                     route_rows: int = 1 << 20) -> PreshardedData:
+    """Per-epoch sharded COO assembly for the STREAMED train path.
+
+    The streamed read leaves the raw encoded COO on the default device
+    (ops/staging.py). This routes it onto the mesh with O(route_rows)
+    host residency: bounded slices transit the host, rows route to
+    their owning device by CONTIGUOUS row block (``row // rows_dev`` —
+    the degenerate LPT deal; per-device nnz balance then rests on the
+    hash-like spread of zipf draws rather than the host-side
+    least-loaded deal, which needs the whole dataset host-resident),
+    per-device slabs ship as they fill, and each device sorts/pads its
+    own block in HBM (:func:`_local_side_layout`). ``pos`` is the
+    identity, so factor scatter/gather need no permutation.
+
+    The assembled layout is bit-compatible with ``prepare_sharded`` at
+    n_dev == 1 (one device owns every row; the stable local sort equals
+    the global sort) — asserted in tier-1 — and deterministic at any
+    n_dev (chunk order is the stream order)."""
+    import jax
+
+    axis = mesh.axis_names[0]
+    n_dev = int(mesh.devices.size)
+    devices = list(mesh.devices.flat)
+    nnz = int(u_dev.shape[0])
+
+    def side(self_dev, other_dev, n_self):
+        rows_dev = max(-(-n_self // n_dev), 1)
+        router = _DeviceRouter(n_dev, devices,
+                               flush_rows=route_rows // max(n_dev, 2))
+        per_dev = np.zeros(n_dev, dtype=np.int64)
+        for lo in range(0, nnz, route_rows):
+            hi = min(nnz, lo + route_rows)
+            s_h = np.asarray(jax.device_get(self_dev[lo:hi]))
+            o_h = np.asarray(jax.device_get(other_dev[lo:hi]))
+            r_h = np.asarray(jax.device_get(r_dev[lo:hi]))
+            dev_of = np.minimum(s_h // rows_dev, n_dev - 1)
+            np.add.at(per_dev, dev_of, 1)
+            local = (s_h - dev_of.astype(np.int32) * rows_dev
+                     ).astype(np.int32)
+            router.add(dev_of, (local, o_h, r_h))
+        nnz_dev = bucket_units(
+            max(-(-int(max(per_dev.max(), 1)) // chunk), 1)) * chunk
+        shards = []
+        counts_shards = []
+        for d in range(n_dev):
+            s_c, o_c, r_c = router.device_columns(
+                d, (np.int32, np.int32, np.float32))
+            s, o, r, counts = _local_side_layout(
+                s_c.astype(jnp.int32), o_c.astype(jnp.int32),
+                r_c.astype(jnp.float32),
+                rows_dev=rows_dev, nnz_dev=nnz_dev)
+            shards.append((s, o, r))
+            counts_shards.append(counts)
+        flat_spec = NamedSharding(mesh, P(axis))
+
+        def assemble(parts, width):
+            return jax.make_array_from_single_device_arrays(
+                (n_dev * width,), flat_spec, [p for p in parts])
+
+        self_g = assemble([sh[0] for sh in shards], nnz_dev)
+        other_g = assemble([sh[1] for sh in shards], nnz_dev)
+        rating_g = assemble([sh[2] for sh in shards], nnz_dev)
+        counts_g = assemble(counts_shards, rows_dev)
+        return ShardedSide(
+            self_idx=self_g, other_idx=other_g, rating=rating_g,
+            counts=counts_g, pos=np.arange(n_self, dtype=np.int32),
+            nnz_per_dev=per_dev, rows_dev=rows_dev, nnz_dev=nnz_dev,
+            n_rows_pad=rows_dev * n_dev)
+
+    su = side(u_dev, i_dev, n_users)
+    si = side(i_dev, u_dev, n_items)
+    # one-element fetches force every per-device layout so the caller's
+    # layout phase owns this wall-clock (KNOWN_ISSUES #3)
+    jax.device_get((su.self_idx[-1:], si.self_idx[-1:]))
+    return PreshardedData(su=su, si=si, n_users=n_users, n_items=n_items,
+                          nnz=nnz)
+
+
 def prepare_sharded(data: ALSData, n_dev: int,
                     chunk: int = 1 << 16) -> Tuple[ShardedSide, ShardedSide]:
     """Shard both orientations and cross-remap other-side indices into the
@@ -186,11 +352,14 @@ def _pad_factors(F: np.ndarray, side: ShardedSide) -> np.ndarray:
     return out
 
 
-def _shard_put(arr: np.ndarray, spec: NamedSharding):
+def _shard_put(arr, spec: NamedSharding):
     """Host array -> sharded device array. Every process holds the full
     host array (they all read the same event store), so each one just
     donates its addressable shards — works identically on a single- or
-    multi-controller runtime."""
+    multi-controller runtime. An already-sharded jax array (the
+    streamed assembly, ``shard_staged_coo``) passes through untouched."""
+    if isinstance(arr, jax.Array) and not isinstance(arr, np.ndarray):
+        return arr
     arr = np.asarray(arr)
     return jax.make_array_from_callback(
         arr.shape, spec, lambda idx: arr[idx])
@@ -306,7 +475,7 @@ def _hybrid_shard_prepare(data: ALSData, su: ShardedSide, si: ShardedSide,
 
 def _train_sharded(
     mesh: Mesh,
-    data: ALSData,
+    data: "Union[ALSData, PreshardedData]",
     rank: int,
     iterations: int,
     lambda_: float,
@@ -323,19 +492,26 @@ def _train_sharded(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
-    su, si = prepare_sharded(data, n_dev, chunk)
+    if isinstance(data, PreshardedData):
+        # streamed assembly (shard_staged_coo): the layout is already on
+        # the mesh; hybrid's dense-hot prep is host-side by construction
+        # and a host dataset copy never existed, so it degrades to csrb
+        su, si = data.su, data.si
+    else:
+        su, si = prepare_sharded(data, n_dev, chunk)
+        flag = _kernel_flag(kernel)
+        if flag == "hybrid":
+            import os
+            K = int(os.environ.get("PIO_ALS_HOT_K", _HOT_K))
+            # same worthwhile-split rule as the single-device driver
+            if data.n_items >= 2 * K and data.n_users >= 2:
+                return _train_sharded_hybrid(
+                    mesh, data, su, si, K, rank, iterations, lambda_, seed,
+                    chunk, reg_scaling, implicit, alpha, u0, v0,
+                    checkpoint_every, checkpointer)
     flag = _kernel_flag(kernel)
-    if flag == "hybrid":
-        import os
-        K = int(os.environ.get("PIO_ALS_HOT_K", _HOT_K))
-        # same worthwhile-split rule as the single-device driver
-        if data.n_items >= 2 * K and data.n_users >= 2:
-            return _train_sharded_hybrid(
-                mesh, data, su, si, K, rank, iterations, lambda_, seed,
-                chunk, reg_scaling, implicit, alpha, u0, v0,
-                checkpoint_every, checkpointer)
-    # hybrid with a too-small item set degrades to csrb, like the
-    # single-device driver
+    # hybrid with a too-small item set (or a presharded streamed layout)
+    # degrades to csrb, like the single-device driver
     csrb = flag in ("csrb", "hybrid")
     b = _CSRB_B
     # per-device csrb plans (static: nnz_dev is the max-padded per-device
@@ -570,7 +746,7 @@ def _train_sharded_hybrid(
 
 def train_explicit_sharded(
     mesh: Mesh,
-    data: ALSData,
+    data: "Union[ALSData, PreshardedData]",
     rank: int = 10,
     iterations: int = 10,
     lambda_: float = 0.01,
@@ -600,7 +776,7 @@ def train_explicit_sharded(
 
 def train_implicit_sharded(
     mesh: Mesh,
-    data: ALSData,
+    data: "Union[ALSData, PreshardedData]",
     rank: int = 10,
     iterations: int = 10,
     lambda_: float = 0.01,

@@ -518,9 +518,11 @@ class _ObjectTail:
 def tail_for(events: Any, app_id: int,
              cfg: FoldinConfig) -> Optional[Any]:
     """The incremental tail for this backend, or None when it exposes
-    neither surface (sqlite/remote today — the fold-in matrix in the
-    README says so; the worker then refuses to start with a journal
-    WARN instead of silently polling)."""
+    neither surface (remote today — the fold-in matrix in the README
+    says so; the worker then refuses to start with a journal WARN
+    instead of silently polling). eventlog and sqlite both expose the
+    columnar ``read_columns_since`` cursor twin; the memory backend the
+    object-shaped ``read_events_since``."""
     if hasattr(events, "read_columns_since"):
         return _ColumnarTail(events, app_id, cfg)
     if hasattr(events, "read_events_since"):

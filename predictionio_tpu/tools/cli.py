@@ -116,6 +116,16 @@ def _apply_read_env(args) -> None:
     if overlap:
         os.environ["PIO_READ_OVERLAP"] = "1" if overlap == "on" else "0"
         os.environ["PIO_READ_STAGE"] = "1" if overlap == "on" else "0"
+    stream = getattr(args, "stream", "")
+    if stream:
+        # out-of-core training read (data/store.py train_stream_mode)
+        os.environ["PIO_TRAIN_STREAM"] = stream
+    if getattr(args, "synthetic", 0):
+        # seeded zipfian generator instead of the event store
+        # (data/synthetic.py env_config)
+        os.environ["PIO_SYNTHETIC_EVENTS"] = str(args.synthetic)
+        if getattr(args, "synthetic_seed", None) is not None:
+            os.environ["PIO_SYNTHETIC_SEED"] = str(args.synthetic_seed)
 
 
 def cmd_train(args) -> int:
@@ -652,6 +662,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="overlap chunk decode with vocab-encode and "
                          "host->HBM staging (default on; sets "
                          "PIO_READ_OVERLAP / PIO_READ_STAGE)")
+    sp.add_argument("--stream", choices=("auto", "on", "off"), default="",
+                    help="out-of-core training read: scan the event log "
+                         "in bounded chunks and stage each chunk to the "
+                         "device as it decodes, so peak HOST memory is "
+                         "O(chunk) instead of O(dataset); off = the "
+                         "bit-compatible in-core path (sets "
+                         "PIO_TRAIN_STREAM; factors are bit-identical "
+                         "either way)")
+    sp.add_argument("--synthetic", type=int, default=0,
+                    help="train on N deterministic synthetic zipfian "
+                         "ratings instead of the event store (seeded "
+                         "generator, no dataset download — the "
+                         "billion-rating scale surface; sets "
+                         "PIO_SYNTHETIC_EVENTS)")
+    sp.add_argument("--synthetic-seed", type=int, default=None,
+                    help="seed for --synthetic (default 7; sets "
+                         "PIO_SYNTHETIC_SEED)")
     sp.add_argument("--compile-cache", default="",
                     help="persistent XLA compile-cache directory; the "
                          "run's new entries export with the model as a "

@@ -517,6 +517,31 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
                        f"{(f32 - i8) / 2**20:.1f} MiB vs fp32")
         checks.append(("hbm", state, detail))
 
+    # host memory (the O(chunk) out-of-core claim's gauge) -------------
+    host = device.get("hostMemory") or {}
+    rss = host.get("rssBytes")
+    if rss is None:
+        checks.append(("host", NA,
+                       _OPT_IN.format("host memory stats")
+                       if telemetry_off
+                       else "no /proc host memory stats (non-Linux)"))
+    else:
+        peak = host.get("peakRssBytes")
+        total = host.get("memTotalBytes")
+        detail = f"rss {_fmt_bytes(rss)}"
+        if peak is not None:
+            detail += f" (peak {_fmt_bytes(peak)})"
+        state = OK
+        if total:
+            frac = rss / total
+            detail += f" of {_fmt_bytes(total)} ({frac * 100:.0f}%)"
+            # WARN only: nearing physical memory is an advisory — the
+            # OOM killer's verdict, when it comes, is terminal anyway
+            if frac >= 0.90:
+                state = WARN
+                detail += " — within 10% of physical memory"
+        checks.append(("host", state, detail))
+
     # traces -----------------------------------------------------------
     tr = _json_body(scraped["traces"])
     if tr is None:

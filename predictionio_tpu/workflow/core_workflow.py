@@ -128,6 +128,20 @@ def run_train(
                 "Auto-resuming from crashed run %s's iteration snapshots "
                 "(disable with --no-auto-resume / PIO_AUTO_RESUME=0)", auto)
             resume_from = auto
+    # out-of-core training mode resolution (PIO_TRAIN_STREAM, data/
+    # store.py): resolved ONCE here against the event source's
+    # capabilities so the ledger row records which read path this run
+    # took; `off` is the bit-compatible in-core path, and a template
+    # that never opts in simply ignores the resolution
+    from predictionio_tpu.data import store as _store
+    try:
+        _events_dao = storage.get_events()
+    except Exception:   # metadata-only storage in tests
+        _events_dao = None
+    train_stream = _store.resolve_train_stream(_events_dao)
+    logger.info("train read path: %s (PIO_TRAIN_STREAM=%s)",
+                "streamed (O(chunk) host)" if train_stream else "in-core",
+                _store.train_stream_mode())
     import json as _json
     pj = params_json or {}
     instance = EngineInstance(
@@ -211,6 +225,8 @@ def run_train(
         instances.update(EngineInstance(
             **{**row.__dict__, "status": "COMPLETED", "end_time": _now(),
                "runtime_conf": {**row.runtime_conf,
+                                "train_stream":
+                                    "on" if train_stream else "off",
                                 **{f"phase_{k}_s": f"{v:.3f}"
                                    for k, v in phases.items()}}}))
         if phases:

@@ -195,6 +195,81 @@ def test_eventlog_cursor_stable_across_compaction(el_events):
     assert ev.cursor_lag(1, cursor=cur3) == 0
 
 
+@pytest.fixture()
+def sq_events(tmp_path):
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "pio.sqlite"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    ev = storage.get_events()
+    ev.init(1)
+    return storage, ev
+
+
+def test_sqlite_cursor_incremental_read(sq_events):
+    """The sqlite twin of the eventlog cursor contract (ISSUE 14
+    satellite; same assertions as test_eventlog_cursor_incremental_read
+    modulo the backend's rowid positions): incremental windows, filters
+    narrowing output but not the consumed range, creation_ms present,
+    zero-cursor reproducing the bulk read."""
+    _storage, ev = sq_events
+    ev.insert_batch([_mk_event("u1", "i1", 5.0),
+                     _mk_event("u2", "i2", 3.0)], 1)
+    head = ev.head_cursor(1)
+    assert head == {"seq": 0, "row": 2}
+    assert ev.cursor_lag(1, cursor={"seq": 0, "row": 0}) == 2
+    assert ev.cursor_lag(1, cursor=head) == 0
+    ev.insert_batch([_mk_event("u3", "i3", 1.0)], 1)
+    cur, cols = ev.read_columns_since(
+        1, cursor=head, event_names=["rate", "buy"],
+        entity_type="user", target_entity_type="item")
+    pool = cols["pool"]
+    assert [pool[c] for c in cols["entity_code"]] == ["u3"]
+    assert cols["creation_ms"].shape == (1,)
+    assert cur == {"seq": 0, "row": 3}
+    # a full read from the zero cursor reproduces read_columns
+    _c0, full = ev.read_columns_since(1, cursor=None)
+    bulk = ev.read_columns(1)
+    assert full["entity_code"].shape == bulk["entity_code"].shape
+    assert sorted(full["rating"].tolist()) == \
+        sorted(bulk["rating"].tolist())
+    # a cursor past the head (external reset) clamps instead of raising
+    c_over, cols_over = ev.read_columns_since(1, cursor={"seq": 0,
+                                                         "row": 999})
+    assert cols_over["entity_code"].shape[0] == 0
+    assert c_over["row"] <= 3
+    # filters narrow output, never the consumed range: a filtered
+    # follower's cursor still converges on the head
+    ev.insert_batch([_mk_event("u4", "i4", 2.0)], 1)
+    cur2, cols2 = ev.read_columns_since(1, cursor=cur,
+                                        event_names=["no-such-event"])
+    assert cols2["entity_code"].shape[0] == 0
+    assert ev.cursor_lag(1, cursor=cur2) == 0
+
+
+def test_sqlite_foldin_tail_selected(sq_events):
+    """The fold-in worker no longer refuses sqlite: tail_for picks the
+    columnar cursor tail (the README backend matrix row)."""
+    from predictionio_tpu.realtime import foldin
+
+    _storage, ev = sq_events
+    ev.insert_batch([_mk_event("u1", "i1", 5.0)], 1)
+    cfg = foldin.FoldinConfig(app_name=APP)
+    tail = foldin.tail_for(ev, 1, cfg)
+    assert tail is not None and tail.kind == "columnar"
+    cur, rows = tail.read({"seq": 0, "row": 0})
+    assert rows == [("u1", "i1", "rate", 5.0, rows[0][4])]
+    assert tail.lag(cur) == 0
+    ev.insert_batch([_mk_event("u9", "i1", 4.0)], 1)
+    assert tail.lag(cur) == 1
+    cur2, rows2 = tail.read(cur)
+    assert [r[0] for r in rows2] == ["u9"]
+
+
 def test_memory_cursor_surface(memory_storage):
     ev = memory_storage.get_events()
     ev.init(1)

@@ -173,6 +173,133 @@ def test_insert_during_long_read_no_deadlock(tmp_path, monkeypatch):
     assert "u-mid-read" in post["pool"]
 
 
+def test_streamed_torn_wal_tail_mid_stream(tmp_path, monkeypatch):
+    """A torn (crash-interrupted) WAL tail met by a STREAMED scan: the
+    unacknowledged partial record is dropped, every acknowledged row
+    survives, and the streamed result still concatenates to the bulk
+    read byte for byte (the bulk path has this test; this is the
+    streamed twin — ISSUE 14 satellite)."""
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    ev = s.get_events()
+    sh = ev._shard(app_id, None)
+    wal = sh.wal_path_for(sh.next_seq)
+    with open(wal, "ab") as f:
+        f.write(b'{"event": "rate", "entityTy')   # torn mid-record
+    # fresh DAO: a reader that has never seen the clean tail
+    ev2 = type(ev)(ev.client, None)
+    whole = ev2.read_columns(app_id, event_names=["rate", "buy"])
+    pool, chunks = ev2.read_columns_streamed(
+        app_id, event_names=["rate", "buy"], read_threads=3)
+    parts = list(chunks)
+    assert pool == whole["pool"]
+    for k in COLS:
+        got = (np.concatenate([p[k] for p in parts]) if parts
+               else np.empty(0))
+        assert got.tobytes() == whole[k].tobytes()
+    # the acknowledged tail rows all made it (5 inserted, 1 tombstoned)
+    assert int((whole["rating"] == 3.5).sum()) >= 4
+
+
+def test_streamed_concurrent_ingest_snapshot(tmp_path, monkeypatch):
+    """Ingest landing BETWEEN streamed chunks: the shard lock is only
+    held for the snapshot, so inserts proceed mid-scan, the in-flight
+    iterator keeps its point-in-time view (no new rows, no dupes), and
+    a follow-up read sees everything."""
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    ev = s.get_events()
+    pre = ev.read_columns(app_id, event_names=["rate", "buy"])
+    pool, chunks = ev.read_columns_streamed(
+        app_id, event_names=["rate", "buy"], read_threads=2)
+    it = iter(chunks)
+    parts = [next(it)]
+    # the scan is mid-flight; this insert must neither block nor leak
+    # into the snapshot
+    ev.insert(Event(event="rate", entity_type="user",
+                    entity_id="u-mid-stream", target_entity_type="item",
+                    target_entity_id="i0",
+                    properties=DataMap({"rating": 1.5})), app_id)
+    parts.extend(it)
+    for k in COLS:
+        got = np.concatenate([p[k] for p in parts])
+        assert got.tobytes() == pre[k].tobytes(), k
+    post = ev.read_columns(app_id, event_names=["rate", "buy"])
+    assert post["rating"].shape[0] == pre["rating"].shape[0] + 1
+    assert "u-mid-stream" in post["pool"]
+
+
+def test_streamed_compaction_race(tmp_path, monkeypatch):
+    """Chunk compaction firing while a streamed scan is mid-iteration:
+    the snapshot's buffer tail was copied under the lock and published
+    chunks are immutable, so the in-flight iterator yields every
+    pre-compaction row exactly once — the rows that just became a chunk
+    come from the snapshot copy, never double-counted from the new
+    chunk file (and the compaction's WAL GC cannot disturb the decode,
+    which reads chunk files only)."""
+    s, app_id = seed_messy_store(tmp_path, monkeypatch)
+    ev = s.get_events()
+    sh = ev._shard(app_id, None)
+    assert sh.buffer, "test needs an unflushed tail"
+    pre = ev.read_columns(app_id, event_names=["rate", "buy"])
+    pool, chunks = ev.read_columns_streamed(
+        app_id, event_names=["rate", "buy"], read_threads=2)
+    it = iter(chunks)
+    parts = [next(it)]
+    n_chunks_before = len(sh.chunk_seqs())
+    ev.flush(app_id)          # buffer -> chunk mid-stream
+    assert len(sh.chunk_seqs()) == n_chunks_before + 1
+    parts.extend(it)
+    for k in COLS:
+        got = np.concatenate([p[k] for p in parts])
+        assert got.tobytes() == pre[k].tobytes(), k
+    # and a FRESH streamed read over the compacted store agrees too
+    pool2, chunks2 = ev.read_columns_streamed(
+        app_id, event_names=["rate", "buy"], read_threads=2)
+    parts2 = list(chunks2)
+    for k in COLS:
+        got = np.concatenate([p[k] for p in parts2])
+        assert got.tobytes() == pre[k].tobytes(), k
+
+
+def test_streamed_decode_ahead_bounded(tmp_path, monkeypatch):
+    """The decode-ahead window is BOUNDED: with a slow consumer, at most
+    O(workers) chunks are decoded beyond what was consumed — a dataset
+    much larger than the window can stream through O(chunk) host memory
+    (ISSUE 14 tentpole; before this, every decoded chunk buffered in
+    completed futures)."""
+    monkeypatch.setattr(el_mod, "_FLUSH_AT", 12)
+    s, app_id = el_storage(tmp_path)
+    ev = s.get_events()
+    for lo in range(0, 30 * 12, 12):     # 30 chunks
+        ev.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{j%7}",
+                  target_entity_type="item", target_entity_id=f"i{j%5}",
+                  properties=DataMap({"rating": 3.0}))
+            for j in range(lo, lo + 12)], app_id)
+    ev.flush(app_id)
+    decoded = []
+    orig = el_mod.EventlogEvents._decode_chunk_columns
+
+    def counting_decode(self, sh, seq, *a, **kw):
+        decoded.append(seq)
+        return orig(self, sh, seq, *a, **kw)
+
+    monkeypatch.setattr(el_mod.EventlogEvents, "_decode_chunk_columns",
+                        counting_decode)
+    threads = 2
+    pool, chunks = ev.read_columns_streamed(app_id, event_names=["rate"],
+                                            read_threads=threads)
+    it = iter(chunks)
+    next(it)                      # consume ONE chunk, then stall
+    import time
+    time.sleep(0.3)               # give eager decode every chance
+    window = 2 * threads
+    assert len(decoded) <= 1 + window + threads, (
+        f"decode-ahead ran {len(decoded)} chunks past a stalled "
+        f"consumer (window {window})")
+    rest = list(it)
+    assert 1 + len(rest) == 30    # everything still arrives, in order
+
+
 def test_overlap_off_matches_overlap_on(tmp_path, monkeypatch):
     s, app_id = seed_messy_store(tmp_path, monkeypatch)
     kw = dict(event_names=["rate", "buy"], entity_type="user",
@@ -362,14 +489,14 @@ def test_cli_read_flags(monkeypatch):
     args = build_parser().parse_args(
         ["train", "--read-threads", "3", "--read-overlap", "off"])
     assert args.read_threads == 3 and args.read_overlap == "off"
-    monkeypatch.delenv("PIO_READ_THREADS", raising=False)
-    monkeypatch.delenv("PIO_READ_OVERLAP", raising=False)
-    monkeypatch.delenv("PIO_READ_STAGE", raising=False)
+    # register the keys with monkeypatch BEFORE the direct writes, so
+    # teardown restores the pre-test state (a trailing delenv on a key
+    # first touched AFTER the write would "restore" the written value —
+    # that exact leak once poisoned every later staging-dependent test)
+    for k in ("PIO_READ_THREADS", "PIO_READ_OVERLAP", "PIO_READ_STAGE"):
+        monkeypatch.setenv(k, "pre")
     import os
     _apply_read_env(args)
     assert os.environ["PIO_READ_THREADS"] == "3"
     assert os.environ["PIO_READ_OVERLAP"] == "0"
     assert os.environ["PIO_READ_STAGE"] == "0"
-    monkeypatch.delenv("PIO_READ_THREADS", raising=False)
-    monkeypatch.delenv("PIO_READ_OVERLAP", raising=False)
-    monkeypatch.delenv("PIO_READ_STAGE", raising=False)
