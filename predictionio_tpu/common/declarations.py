@@ -212,6 +212,20 @@ ENV_VARS: Dict[str, str] = {
     "PIO_COMPILE_CACHE_MIN_S":
         "minimum compile seconds before a program is persisted to the "
         "compile cache (default 0)",
+    # ------------------------------------------------------------ router
+    "PIO_ROUTER_HEALTH_MS":
+        "router membership poll cadence in ms — each backend's /readyz "
+        "is probed this often for eject/re-admit and generation "
+        "(default 500)",
+    "PIO_ROUTER_DEADLINE_MS":
+        "router per-query deadline budget in ms, propagated to the "
+        "backend as X-PIO-Deadline-Ms and spent across the failover "
+        "retry; a smaller incoming X-PIO-Deadline-Ms wins (default "
+        "2000)",
+    "PIO_ROUTER_MAX_INFLIGHT":
+        "router admission ceiling: concurrent in-flight forwards beyond "
+        "this answer 503 + Retry-After instead of queueing (default "
+        "256)",
     # -------------------------------------------------------- resilience
     "PIO_RPC_RETRIES":
         "remote-storage retry attempts for idempotent calls (default 3)",
@@ -365,6 +379,19 @@ METRICS: Dict[str, str] = {
     "pio_staging_finalize_enqueue_seconds":
         "staging finalize ENQUEUE time (async stream deliberately "
         "unsynced; the layout phase owns the barrier)",
+    # -------------------------------------------------------------- router
+    "pio_router_requests_total":
+        "routed /queries.json requests by outcome (ok / failover_ok / "
+        "shed / deadline / error)",
+    "pio_router_failovers_total":
+        "forwards retried on another replica after a transport failure "
+        "or timeout on the first",
+    "pio_router_overhead_seconds":
+        "router-added latency per request (handler time minus the "
+        "backend call — the <= 1 ms front-door budget)",
+    "pio_router_backend_up":
+        "1 while a backend is in rotation (healthy + admitted by the "
+        "reload barrier), 0 while ejected",
     # ----------------------------------------------------------- transport
     "pio_http_requests_total": "HTTP requests by path/code",
     "pio_http_request_seconds": "HTTP request handling latency",
@@ -450,6 +477,11 @@ JOURNAL_CATEGORIES: Dict[str, str] = {
         "realtime fold-in lifecycle: worker bound to a generation, "
         "headroom-exhausted /reload fallback, failed ticks, drift-"
         "probe failures (realtime/foldin.py)",
+    "router":
+        "replica-fleet front door: backend ejection (red) / "
+        "re-admission (info), reload-barrier begin/cutover/complete, "
+        "barrier aborts leaving generation skew (red) "
+        "(workflow/router.py)",
 }
 
 

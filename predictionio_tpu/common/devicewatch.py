@@ -458,6 +458,11 @@ class RssWatcher:
         self._thread: Optional[threading.Thread] = None
         self.peak_rss = 0
         self.peak_pipeline = 0   # max over samples of rss - live_bytes
+        #: the FIRST sample's pipeline value — long-lived processes
+        #: (the shared test runner) measure their own growth as
+        #: peak_pipeline - baseline_pipeline instead of inheriting
+        #: every earlier allocation in the absolute number
+        self.baseline_pipeline: Optional[int] = None
         self.samples = 0
 
     def _run(self) -> None:
@@ -470,6 +475,8 @@ class RssWatcher:
                     self.peak_rss = rss
                 live = _live_array_stats()["bytes"]
                 pipeline = max(rss - live, 0)
+                if self.baseline_pipeline is None:
+                    self.baseline_pipeline = pipeline
                 if pipeline > self.peak_pipeline:
                     self.peak_pipeline = pipeline
             self._stop.wait(self._interval)

@@ -142,6 +142,19 @@ class StorageRPCAPI:
             return None if got is None else _enc_event(got)
         if m == "delete":
             return ev.delete(a["event_id"], app, ch)
+        if m == "head_cursor":
+            # incremental-tail twins (fold-in over a remote EVENTDATA
+            # source): cursors are plain JSON dicts, lags plain ints —
+            # only the bulk column read itself needs the binary route
+            if not hasattr(ev, "head_cursor"):
+                raise ValueError(
+                    "backing event store has no cursor-tail support")
+            return ev.head_cursor(app, ch)
+        if m == "cursor_lag":
+            if not hasattr(ev, "cursor_lag"):
+                raise ValueError(
+                    "backing event store has no cursor-tail support")
+            return int(ev.cursor_lag(app, ch, a.get("cursor")))
         if m == "find":
             # offset+limit window: the client driver pages with this so one
             # reply never buffers an unbounded JSON array (verdict r3 #3)
@@ -327,6 +340,48 @@ class StorageRPCAPI:
         parts.extend(memoryview(v) for v in arrays.values())
         return b"".join(parts)
 
+    def _read_columns_since_raw(self, body: bytes) -> bytes:
+        """Incremental cursor read over the binary "PIOC" wire — the
+        remote twin of ``eventlog.read_columns_since`` (fold-in tails a
+        remote EVENTDATA source through this). The advanced cursor rides
+        the JSON header next to the column table; the ``creation_ms``
+        column (the freshness clock's start) ships like every other
+        array."""
+        import numpy as np
+
+        a = json.loads(body.decode("utf-8"))
+        ev = self.storage.get_events()
+        if not hasattr(ev, "read_columns_since"):
+            raise ValueError(
+                "backing event store has no cursor-tail support")
+        cursor, cols = ev.read_columns_since(
+            a["app_id"], a.get("channel_id"), a.get("cursor"),
+            event_names=a.get("event_names"),
+            entity_type=a.get("entity_type"),
+            target_entity_type=a.get("target_entity_type"),
+            rating_property=a.get("rating_property", "rating"))
+        arrays = {
+            "entity_code": np.ascontiguousarray(cols["entity_code"],
+                                                dtype=np.int32),
+            "target_code": np.ascontiguousarray(cols["target_code"],
+                                                dtype=np.int32),
+            "event_code": np.ascontiguousarray(cols["event_code"],
+                                               dtype=np.int32),
+            "rating": np.ascontiguousarray(cols["rating"], dtype=np.float32),
+            "time_ms": np.ascontiguousarray(cols["time_ms"], dtype=np.int64),
+            "creation_ms": np.ascontiguousarray(cols["creation_ms"],
+                                                dtype=np.int64),
+        }
+        header = json.dumps({
+            "pool": cols["pool"],
+            "cursor": cursor,
+            "cols": [[k, str(v.dtype), int(v.shape[0])]
+                     for k, v in arrays.items()]}).encode("utf-8")
+        import struct
+        parts = [b"PIOC", struct.pack("<I", len(header)), header]
+        parts.extend(memoryview(v) for v in arrays.values())
+        return b"".join(parts)
+
     def _readyz(self):
         """Readiness: not draining AND the backing storage constructs its
         DAOs (a broken PATH / lost mount turns the probe red before load
@@ -339,7 +394,7 @@ class StorageRPCAPI:
         except Exception as e:
             return 503, {"status": "unready",
                          "message": f"{type(e).__name__}: {e}"}
-        return 200, {"status": "ready", "proto": 2}
+        return 200, {"status": "ready", "proto": 3}
 
     def handle(self, method: str, path: str,
                query: Optional[Dict[str, str]] = None,
@@ -362,8 +417,10 @@ class StorageRPCAPI:
                 self.key.encode("utf-8", "surrogateescape")):
             return 401, {"message": "invalid storage key"}
         if method == "GET" and path == "/":
-            # proto 2 = offset-paged find + binary read_columns/model routes
-            return 200, {"status": "alive", "proto": 2}
+            # proto 2 = offset-paged find + binary read_columns/model
+            # routes; proto 3 adds the cursor-tail surface
+            # (head_cursor / cursor_lag / binary read_columns_since)
+            return 200, {"status": "alive", "proto": 3}
         # client-propagated deadline (X-PIO-Deadline-Ms carries the budget
         # REMAINING at send time): a request whose budget is already spent
         # fast-fails instead of doing work nobody is waiting for
@@ -377,6 +434,8 @@ class StorageRPCAPI:
         try:
             if path == "/rpc/read_columns" and method == "POST":
                 return 200, self._read_columns_raw(body)
+            if path == "/rpc/read_columns_since" and method == "POST":
+                return 200, self._read_columns_since_raw(body)
             if path == "/rpc/model" and method == "POST":
                 # raw binary model blob; no base64, no JSON envelope
                 mid = (query or {}).get("id", "")
@@ -599,6 +658,8 @@ class StorageClient:
     _IDEMPOTENT = frozenset({
         "get", "get_by_name", "get_all", "get_by_appid",
         "get_latest_completed", "get_completed", "find", "init",
+        # cursor-tail reads: pure point-in-time reads, safely replayed
+        "head_cursor", "cursor_lag",
     })
 
     #: transport failures eligible for an idempotent retry; includes
@@ -889,6 +950,73 @@ class RemoteEvents(Events):
                     return
 
         return pages_reversed() if reversed_ else pages_forward()
+
+    # -- incremental cursor tail (realtime fold-in over a remote source) ----
+
+    def cursor_tail_supported(self) -> bool:
+        """Does the server expose the cursor-tail surface (proto >= 3,
+        i.e. head_cursor / cursor_lag / the binary read_columns_since
+        route)? Feature-detected so `pio foldin` against an old storage
+        server refuses cleanly instead of failing per tick."""
+        return self.c.proto() >= 3
+
+    def head_cursor(self, app_id, channel_id=None):
+        return self.c.call("events", "head_cursor", app_id=app_id,
+                           channel_id=channel_id)
+
+    def cursor_lag(self, app_id, channel_id=None, cursor=None) -> int:
+        return int(self.c.call("events", "cursor_lag", app_id=app_id,
+                               channel_id=channel_id, cursor=cursor))
+
+    def read_columns_since(self, app_id, channel_id=None, cursor=None,
+                           event_names=None, entity_type=None,
+                           target_entity_type=None,
+                           rating_property: str = "rating"):
+        """Incremental twin of :meth:`read_columns` over the binary
+        "PIOC" route: ``(new_cursor, columns)`` with the bulk-read keys
+        plus ``creation_ms``. A tick's window is bounded by the tick
+        interval, so one reply stays small."""
+        import struct
+
+        import numpy as np
+
+        if not self.cursor_tail_supported():
+            raise NotImplementedError(
+                "storage server predates the cursor-tail surface "
+                "(proto < 3)")
+        body = json.dumps({
+            "app_id": app_id, "channel_id": channel_id, "cursor": cursor,
+            "event_names": list(event_names) if event_names else None,
+            "entity_type": entity_type,
+            "target_entity_type": target_entity_type,
+            "rating_property": rating_property}).encode()
+        status, payload = self.c.request_raw(
+            "POST", "/rpc/read_columns_since", body, idempotent=True)
+        if (status == 400 and b"cursor-tail" in payload) or status == 404:
+            raise NotImplementedError(
+                "backing store has no cursor-tail support")
+        if status != 200:
+            raise RuntimeError(
+                f"storage server error {status}: {payload[:200]!r}")
+        if payload[:4] != b"PIOC":
+            raise RuntimeError("malformed columnar reply (bad magic)")
+        hlen = struct.unpack("<I", payload[4:8])[0]
+        header = json.loads(payload[8:8 + hlen].decode("utf-8"))
+        expected = 8 + hlen + sum(
+            n * np.dtype(dtype).itemsize
+            for _name, dtype, n in header["cols"])
+        if len(payload) < expected:
+            raise RuntimeError(
+                f"truncated columnar reply ({len(payload)} of "
+                f"{expected} bytes)")
+        out = {"pool": header["pool"]}
+        mv = memoryview(payload)
+        off = 8 + hlen
+        for name, dtype, n in header["cols"]:
+            dt = np.dtype(dtype)
+            out[name] = np.frombuffer(mv, dtype=dt, count=n, offset=off)
+            off += n * dt.itemsize
+        return header["cursor"], out
 
     def read_columns(self, app_id, channel_id=None, event_names=None,
                      entity_type=None, target_entity_type=None,

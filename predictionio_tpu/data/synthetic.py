@@ -183,10 +183,15 @@ def training_data(n_events: int, seed: int = 7, n_users: int = 0,
 
 
 def write_events(src: ChunkSource, storage, app_id: int,
-                 channel_id: Optional[int] = None) -> int:
+                 channel_id: Optional[int] = None,
+                 batch: int = 4096) -> int:
     """Materialize the config into a real event store (tests / small
     runs). Uses the bulk columnar append when the backend has one
-    (eventlog), else Event-object inserts."""
+    (eventlog); every other backend streams ``insert_batch`` calls of
+    at most ``batch`` Event objects, so host memory stays O(batch) —
+    never O(chunk) of per-event Python objects — and a billion-rating
+    config can feed a real store at the same O(chunk) ceiling the
+    streamed training read holds (ROADMAP PR 14 follow-up)."""
     ev = storage.get_events()
     ev.init(app_id, channel_id)
     pool = src.pool()
@@ -211,20 +216,25 @@ def write_events(src: ChunkSource, storage, app_id: int,
     from predictionio_tpu.data.datamap import DataMap
     from predictionio_tpu.data.event import Event
 
+    batch = max(1, int(batch))
     for ch in src.chunks():
-        evs = []
-        for ent, tgt, t, r in zip(ch["entity_code"].tolist(),
-                                  ch["target_code"].tolist(),
-                                  ch["time_ms"].tolist(),
-                                  ch["rating"].tolist()):
-            evs.append(Event(
+        n = ch["entity_code"].shape[0]
+        for lo in range(0, n, batch):
+            hi = min(lo + batch, n)
+            evs = [Event(
                 event="rate", entity_type="user", entity_id=pool[ent],
                 target_entity_type="item", target_entity_id=pool[tgt],
                 properties=DataMap({"rating": float(r)}),
                 event_time=_dt.datetime.fromtimestamp(
-                    t / 1000.0, tz=_dt.timezone.utc)))
-        ev.insert_batch(evs, app_id, channel_id)
-        total += len(evs)
+                    t / 1000.0, tz=_dt.timezone.utc))
+                for ent, tgt, t, r in zip(
+                    ch["entity_code"][lo:hi].tolist(),
+                    ch["target_code"][lo:hi].tolist(),
+                    ch["time_ms"][lo:hi].tolist(),
+                    ch["rating"][lo:hi].tolist())]
+            ev.insert_batch(evs, app_id, channel_id)
+            total += len(evs)
+            del evs   # the slice's Event objects never outlive the insert
     return total
 
 

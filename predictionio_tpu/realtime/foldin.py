@@ -518,11 +518,20 @@ class _ObjectTail:
 def tail_for(events: Any, app_id: int,
              cfg: FoldinConfig) -> Optional[Any]:
     """The incremental tail for this backend, or None when it exposes
-    neither surface (remote today — the fold-in matrix in the README
-    says so; the worker then refuses to start with a journal WARN
-    instead of silently polling). eventlog and sqlite both expose the
-    columnar ``read_columns_since`` cursor twin; the memory backend the
-    object-shaped ``read_events_since``."""
+    neither surface (the worker then refuses to start with a journal
+    WARN instead of silently polling). eventlog and sqlite both expose
+    the columnar ``read_columns_since`` cursor twin; the memory backend
+    the object-shaped ``read_events_since``; the remote driver forwards
+    the columnar surface (proto 3) and declares support dynamically via
+    ``cursor_tail_supported`` — an old storage server refuses here, at
+    bind time, not per tick."""
+    supported = getattr(events, "cursor_tail_supported", None)
+    if supported is not None:
+        try:
+            if not supported():
+                return None
+        except Exception:
+            return None   # server unreachable: refuse like unsupported
     if hasattr(events, "read_columns_since"):
         return _ColumnarTail(events, app_id, cfg)
     if hasattr(events, "read_events_since"):

@@ -33,11 +33,12 @@ _NO_ROUTE = "daemon-no-handle-route"
 
 _TELEMETRY_REL = "predictionio_tpu/common/telemetry.py"
 
-#: the three daemons' route handlers (architectural constant)
+#: the daemons' route handlers (architectural constant)
 DAEMON_MODULES = (
     "predictionio_tpu/workflow/create_server.py",   # query (QueryAPI)
     "predictionio_tpu/data/api/service.py",         # event (EventAPI)
     "predictionio_tpu/data/storage/remote.py",      # storage (RPC API)
+    "predictionio_tpu/workflow/router.py",          # fleet (RouterAPI)
 )
 
 

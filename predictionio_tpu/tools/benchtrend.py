@@ -111,6 +111,13 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     ("foldin_freshness_p99_s", "down", False),
     ("foldin_overhead_p99_pct", "down", False),
     ("foldin_cursor_lag_events", "down", False),
+    # scale-out era (workflow/router.py): the fleet front door's added
+    # p99 (hard-gated at <= 1 ms by the bench's router leg under
+    # BENCH_STRICT_EXTRAS=1 on >= 4-core hosts) and the 1->2 replica
+    # QPS scaling (>= 1.6x, same gate) — trended so front-door fat or a
+    # scaling regression is visible round over round
+    ("router_added_p99_ms", "down", False),
+    ("router_qps_scaling_2", "up", False),
     # static-analysis era (tools/analyze): `pio lint` runs inside the
     # bench's strict leg; findings are gated at 0 absolutely below,
     # suppressed counts are trended so baseline debt is visible per

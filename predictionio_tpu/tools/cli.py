@@ -281,18 +281,25 @@ def cmd_doctor(args) -> int:
     """One-screen operator verdict against a running daemon's
     observability surface (tools/doctor.py): health, readiness, queue
     depth, serve p99, circuit breakers, degraded batches, post-warmup
-    XLA recompiles, HBM headroom, trace buffer. Exit 0 green / 1 red /
+    XLA recompiles, HBM headroom, trace buffer — plus the router line
+    (membership, per-backend breakers, added-latency p99, generation
+    skew) when the target is a `pio router`. `--targets url,...` runs
+    the same verdict over every fleet member (router + replicas +
+    storage) and exits with the WORST code. Exit 0 green / 1 red /
     2 unreachable."""
-    from predictionio_tpu.tools.doctor import run_doctor
+    from predictionio_tpu.tools.doctor import run_doctor, run_doctor_fleet
+    if getattr(args, "targets", ""):
+        return run_doctor_fleet(_parse_targets(args.targets),
+                                timeout=args.timeout)
     url = args.url or f"http://{args.ip}:{args.port}"
     return run_doctor(url, timeout=args.timeout)
 
 
-def _parse_targets(raw: str) -> List[str]:
+def _parse_targets(raw: str, flag: str = "--targets") -> List[str]:
     targets = [t.strip() for t in (raw or "").split(",") if t.strip()]
     if not targets:
         raise CommandError(
-            "--targets requires at least one daemon base URL "
+            f"{flag} requires at least one daemon base URL "
             "(comma-separated, e.g. "
             "http://host:8000,http://host:7070)")
     return targets
@@ -365,6 +372,28 @@ def cmd_run(args) -> int:
 # ---------------------------------------------------------------------------
 # daemons
 # ---------------------------------------------------------------------------
+
+def cmd_router(args) -> int:
+    """Fleet front door (workflow/router.py): fan /queries.json out to
+    N query-server replicas with health-driven membership, per-request
+    failover, load shedding, and the coordinated /reload hot-swap
+    barrier."""
+    from predictionio_tpu.workflow.router import (
+        RouterAPI, RouterConfig, serve,
+    )
+    _apply_telemetry_env(args)
+    config = RouterConfig(
+        backends=tuple(_parse_targets(args.backends, flag="--backends")),
+        ip=args.ip, port=args.port,
+        health_ms=args.health_ms,
+        deadline_ms=args.deadline_ms,
+        max_inflight=args.max_inflight)
+    api = RouterAPI(config)
+    _info(f"Router is live at http://{args.ip}:{args.port} over "
+          f"{len(api.backends)} backend(s).")
+    serve(api, host=args.ip, port=args.port)
+    return 0
+
 
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api import EventAPI, EventServerConfig
@@ -798,6 +827,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="daemon base URL (default http://<ip>:<port>)")
     sp.add_argument("--ip", default="localhost")
     sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--targets", default="",
+                    help="comma-separated fleet base URLs (router + "
+                         "replicas + storage): run the verdict over "
+                         "every member, exit with the worst code")
     sp.add_argument("--timeout", type=float, default=5.0,
                     help="per-scrape timeout in seconds")
 
@@ -879,6 +912,29 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("main_class")
     sp.add_argument("args", nargs="*")
     sp.add_argument("--engine-dir", default=".")
+
+    sp = sub.add_parser(
+        "router",
+        help="start the replica-fleet front door: fan /queries.json "
+             "out to N query-server replicas with failover, load "
+             "shedding, and the coordinated /reload hot-swap barrier "
+             "(workflow/router.py)")
+    sp.add_argument("--backends", required=True,
+                    help="comma-separated query-server base URLs, e.g. "
+                         "http://host:8000,http://host:8001")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=8100)
+    sp.add_argument("--health-ms", type=float, default=0.0,
+                    help="membership poll cadence in ms (0 = "
+                         "PIO_ROUTER_HEALTH_MS or 500)")
+    sp.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-query deadline budget in ms, propagated "
+                         "as X-PIO-Deadline-Ms (0 = "
+                         "PIO_ROUTER_DEADLINE_MS or 2000)")
+    sp.add_argument("--max-inflight", type=int, default=0,
+                    help="admission ceiling before 503 + Retry-After "
+                         "(0 = PIO_ROUTER_MAX_INFLIGHT or 256)")
+    telemetry_flags(sp)
 
     sp = sub.add_parser("eventserver", help="start the event server")
     sp.add_argument("--ip", default="0.0.0.0")
@@ -986,6 +1042,7 @@ _DISPATCH = {
     "lint": cmd_lint,
     "profile": cmd_profile,
     "run": cmd_run,
+    "router": cmd_router,
     "eventserver": cmd_eventserver,
     "dashboard": cmd_dashboard,
     "adminserver": cmd_adminserver,

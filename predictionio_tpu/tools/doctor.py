@@ -154,9 +154,12 @@ def _get(base_url: str, path: str, timeout: float):
 
 
 def scrape(base_url: str, timeout: float = 5.0) -> Dict[str, Any]:
-    """Every surface the verdict reads, fetched once."""
+    """Every surface the verdict reads, fetched once. ``root`` (GET /)
+    feeds the router line — a fleet front door's membership, barrier
+    and generation state lives in its status payload."""
     out: Dict[str, Any] = {"url": base_url}
     for key, path in (("healthz", "/healthz"), ("readyz", "/readyz"),
+                      ("root", "/"),
                       ("metrics", "/metrics"),
                       ("traces", "/traces.json?limit=8"),
                       ("device", "/debug/device.json"),
@@ -288,6 +291,43 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
                            f"{budget_txt})"))
         else:
             checks.append(("slo", OK, f"within budget ({budget_txt})"))
+
+    # router fleet front door (workflow/router.py) ---------------------
+    root = _json_body(scraped.get("root", {})) or {}
+    if root.get("router"):
+        backends = root.get("backends") or []
+        in_rot = sum(1 for b in backends if b.get("inRotation"))
+        per = "; ".join(
+            f"{b.get('url', '?')} "
+            f"{'IN' if b.get('inRotation') else 'OUT'}"
+            f" gen {b.get('generation', '?')}"
+            f" breaker {b.get('breaker', '?')}"
+            for b in backends)
+        added_p99 = histogram_quantile(
+            samples, "pio_router_overhead_seconds", 0.99)
+        detail = f"{in_rot}/{len(backends)} in rotation ({per})"
+        if added_p99 is not None:
+            ms = ("inf" if added_p99 == float("inf")
+                  else f"{added_p99 * 1e3:g}")
+            detail += f", added-latency p99 <= {ms} ms"
+        shed = root.get("shedCount") or 0
+        if shed:
+            detail += f", {shed} shed (503)"
+        if in_rot == 0:
+            checks.append(("router", RED,
+                           "NO backend in rotation — every query sheds "
+                           f"503 ({per})"))
+        elif root.get("generationSkew"):
+            checks.append(("router", WARN,
+                           detail + " — GENERATION SKEW "
+                           f"{root.get('generations')}: a reload "
+                           "barrier aborted partway; re-run POST "
+                           "/reload (KNOWN_ISSUES #15)"))
+        elif any(b.get("breaker") == "open" for b in backends):
+            checks.append(("router", WARN,
+                           detail + " — a backend breaker is open"))
+        else:
+            checks.append(("router", OK, detail))
 
     # circuit breakers -------------------------------------------------
     open_eps = [labels for labels, v in
@@ -652,3 +692,17 @@ def run_doctor(base_url: str, timeout: float = 5.0,
     if scraped["healthz"]["status"] is None:
         return 2
     return 1 if any(s == RED for _c, s, _d in checks) else 0
+
+
+def run_doctor_fleet(targets: List[str], timeout: float = 5.0,
+                     out=None) -> int:
+    """`pio doctor --targets url,...`: one verdict per fleet member
+    (router, replicas, storage — the router is just one more daemon
+    here), separated by a blank line; the exit code is the WORST member
+    (2 unreachable > 1 red > 0 green)."""
+    worst = 0
+    for k, url in enumerate(targets):
+        if k:
+            print("", file=out)
+        worst = max(worst, run_doctor(url, timeout=timeout, out=out))
+    return worst

@@ -657,6 +657,9 @@ class QueryAPI:
             "degradedCount": self.degraded_count,
             "draining": self._draining.is_set(),
             "serverStartTime": format_event_time(self.start_time),
+            # model generation (bumped per _load): the router's reload
+            # barrier and `pio doctor` key fleet coordination off it
+            "generation": self.generation,
         }
         batcher = self._batcher
         out["batching"] = ({"enabled": True, **batcher.stats()}
@@ -689,7 +692,8 @@ class QueryAPI:
         and the engine's storage answers a trivial probe. 503 while
         draining so load balancers stop routing here before shutdown."""
         if self._draining.is_set():
-            return 503, {"status": "draining"}
+            return 503, {"status": "draining",
+                         "generation": self.generation}
         checks: Dict[str, Any] = {}
         ready = True
         with self._lock:
@@ -719,7 +723,10 @@ class QueryAPI:
             checks["storage"] = f"{type(e).__name__}: {e}"
             ready = False
         status = 200 if ready else 503
-        return status, {"status": "ready" if ready else "unready", **checks}
+        # generation rides the readiness probe so the router's membership
+        # poll learns "which model is this replica on" in the same read
+        return status, {"status": "ready" if ready else "unready",
+                        "generation": self.generation, **checks}
 
     def _reload(self) -> None:
         try:

@@ -1,0 +1,823 @@
+"""`pio router` — the fault-tolerant front door of a query-server fleet.
+
+One process, however sharded or quantized, caps at one host; ROADMAP
+item 5 is the scale-OUT half. This daemon fans ``POST /queries.json``
+out to N query-server replicas over keep-alive connections, and the
+product is robustness, not routing cleverness — a fleet only earns its
+second replica if the front door survives a replica dying mid-request:
+
+- **Health-driven membership.** A poller thread reads each backend's
+  ``/readyz`` (liveness + readiness + the model ``generation`` id) on a
+  ``PIO_ROUTER_HEALTH_MS`` cadence; a failing backend is ejected from
+  rotation and re-admitted when the probe recovers, with a journal
+  event (category ``router``) on every transition. Each backend also
+  carries its own always-on :class:`resilience.CircuitBreaker`, so a
+  replica failing *requests* (not just probes) fast-fails out of
+  rotation between polls.
+- **Per-request failover.** ``POST /queries.json`` is a pure read, so a
+  forward that fails in transport or times out on one replica is
+  retried ONCE on another (``resilience.RetryPolicy`` bounds the
+  schedule). The router's deadline budget (``PIO_ROUTER_DEADLINE_MS``,
+  or a smaller incoming ``X-PIO-Deadline-Ms``) is propagated to the
+  backend and spent across attempts: a spent budget answers 504 instead
+  of retrying. No other route is ever failover-retried — a
+  non-idempotent request replayed after a torn response could
+  double-apply (KNOWN_ISSUES #15).
+- **Load shedding.** Admission is bounded (``PIO_ROUTER_MAX_INFLIGHT``)
+  and an empty rotation (every backend ejected, draining or
+  breaker-open) answers the existing ``503 + Retry-After`` contract
+  immediately — the router never queues unboundedly in front of a dead
+  fleet.
+- **Coordinated hot-swap barrier.** ``POST /reload`` drains each
+  backend's reload one at a time behind the QueryAPI ``generation`` id:
+  queries keep routing ONLY to backends still on the old generation
+  while replicas flip one by one; when a single old replica remains the
+  router cuts over atomically to the already-flipped set, then reloads
+  the last one. A fleet therefore never serves two model generations
+  to one client (per-client responses are generation-monotonic) and
+  zero queries drop during the swap — each replica's own in-process
+  hot-swap keeps its in-flight requests answered.
+
+The router is itself a first-class daemon on the shared transport
+(data/api/http.py — ``PIO_TRANSPORT=async`` gives it the keep-alive
+event loop): ``/metrics``, ``/healthz``, ``/readyz``,
+``/debug/events.json`` and the rest of ``telemetry.handle_route``, plus
+trace adoption — an incoming ``X-PIO-Trace`` is propagated to the
+chosen backend so ``pio trace`` assembles router→replica trees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.common import journal, resilience, telemetry, tracing
+
+logger = logging.getLogger("predictionio_tpu.router")
+
+#: (status, payload) or (status, payload, extra_headers) — same handler
+#: contract as every other daemon on the shared transport.
+Response = Tuple[int, Any]
+
+#: transport failures that trigger a failover retry (torn keep-alive
+#: responses after a replica kill surface as HTTPException)
+_TRANSPORT_ERRORS = (ConnectionError, OSError, http.client.HTTPException)
+
+
+def _env_pos(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        v = float(raw) if raw else default
+    except ValueError:
+        v = default
+    return v if v > 0 else default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        v = int(raw) if raw else default
+    except ValueError:
+        v = default
+    return v if v > 0 else default
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """`pio router` args. Every knob has an env twin so a config-managed
+    fleet and an ad-hoc one read the same defaults."""
+    backends: Tuple[str, ...] = ()
+    ip: str = "localhost"
+    port: int = 8100
+    #: membership poll cadence (each backend's /readyz) in ms
+    health_ms: float = 0.0
+    #: per-query deadline budget in ms (an incoming X-PIO-Deadline-Ms
+    #: smaller than this wins); spent budget = 504, never a retry
+    deadline_ms: float = 0.0
+    #: admission ceiling: concurrent in-flight forwards beyond this shed
+    #: with 503 + Retry-After instead of queueing
+    max_inflight: int = 0
+
+    def resolved(self) -> "RouterConfig":
+        return dataclasses.replace(
+            self,
+            health_ms=self.health_ms or _env_pos("PIO_ROUTER_HEALTH_MS", 500.0),
+            deadline_ms=(self.deadline_ms
+                         or _env_pos("PIO_ROUTER_DEADLINE_MS", 2000.0)),
+            max_inflight=(self.max_inflight
+                          or _env_int("PIO_ROUTER_MAX_INFLIGHT", 256)))
+
+
+def _parse_backend(url: str) -> Tuple[str, int]:
+    u = url.strip()
+    if "://" in u:
+        scheme, u = u.split("://", 1)
+        if scheme.lower() != "http":
+            raise ValueError(
+                f"router backends must be http:// URLs, got {url!r}")
+    host, _, port = u.partition(":")
+    if not host or not port.rstrip("/").isdigit():
+        raise ValueError(
+            f"router backend {url!r} must be host:port or http://host:port")
+    return host, int(port.rstrip("/"))
+
+
+class _Backend:
+    """One replica: membership state + keep-alive connections + breaker.
+
+    ``healthy`` is the poller's verdict (readiness probe), ``admitted``
+    the reload barrier's (a flipped-but-not-cut-over replica is healthy
+    yet held out of rotation). A backend serves queries only when both
+    hold AND its breaker admits the call.
+    """
+
+    #: idle keep-alive sockets retained per backend
+    POOL = 4
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.host, self.port = _parse_backend(url)
+        self.name = f"{self.host}:{self.port}"
+        self.healthy = False
+        self.admitted = True
+        self.generation: Optional[int] = None
+        self.draining = False
+        #: always-on breaker (unlike the remote driver's opt-in
+        #: registry): a fleet front door without one queues on corpses.
+        #: Tuned by the same PIO_BREAKER_* knobs operators already know.
+        self.breaker = resilience.CircuitBreaker(
+            self.name,
+            window_s=_env_pos("PIO_BREAKER_WINDOW_S", 30.0),
+            error_threshold=_env_pos("PIO_BREAKER_ERROR_RATE", 0.5),
+            min_calls=_env_int("PIO_BREAKER_MIN_CALLS", 10),
+            open_s=_env_pos("PIO_BREAKER_OPEN_S", 5.0))
+        self._idle: List[http.client.HTTPConnection] = []
+        self._idle_lock = threading.Lock()
+
+    # ------------------------------------------------------------- transport
+    def _acquire(self, timeout: float) -> http.client.HTTPConnection:
+        with self._idle_lock:
+            conn = self._idle.pop() if self._idle else None
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=timeout)
+        elif conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        return conn
+
+    def _release(self, conn, reusable: bool) -> None:
+        if reusable:
+            with self._idle_lock:
+                if len(self._idle) < self.POOL:
+                    self._idle.append(conn)
+                    return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def request(self, method: str, path: str, body: bytes,
+                headers: Dict[str, str], timeout: float
+                ) -> Tuple[int, bytes, Dict[str, str]]:
+        """One forwarded request over a pooled keep-alive connection.
+        Raises the transport error on failure; a failed socket is never
+        re-pooled (the failover retry dials fresh elsewhere)."""
+        conn = self._acquire(timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            rheaders = {k.lower(): v for k, v in resp.getheaders()}
+            self._release(conn, reusable=not resp.will_close)
+            return resp.status, payload, rheaders
+        except BaseException:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
+
+    def probe(self, timeout: float = 2.0
+              ) -> Tuple[bool, bool, Optional[int]]:
+        """(healthy, draining, generation) from one /readyz read over a
+        FRESH connection — a pooled keep-alive socket can outlive the
+        listener it connected to, and membership must answer "can a new
+        request reach this replica", not "does an old socket still
+        drain". A 503 body still carries ``status``/``generation`` — a
+        draining replica is distinguishable from a dead one."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            status, payload = resp.status, resp.read()
+        except _TRANSPORT_ERRORS:
+            return False, False, None
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        gen: Optional[int] = None
+        draining = False
+        try:
+            obj = json.loads(payload)
+            if isinstance(obj, dict):
+                if obj.get("generation") is not None:
+                    gen = int(obj["generation"])
+                draining = obj.get("status") == "draining"
+        except (ValueError, TypeError):
+            pass
+        return status == 200, draining, gen
+
+    def close(self) -> None:
+        with self._idle_lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "url": self.url,
+            "healthy": self.healthy,
+            "inRotation": self.healthy and self.admitted,
+            "draining": self.draining,
+            "generation": self.generation,
+            "breaker": self.breaker.state,
+        }
+
+
+class RouterAPI:
+    """Pure route handler for the fleet front door (hosted by
+    data/api/http.make_server like every other daemon)."""
+
+    def __init__(self, config: RouterConfig):
+        if not config.backends:
+            raise ValueError("router needs at least one backend "
+                             "(--backends url,...)")
+        self.config = config.resolved()
+        self.backends = [_Backend(u) for u in self.config.backends]
+        if len({b.name for b in self.backends}) != len(self.backends):
+            raise ValueError("router backends must be distinct host:port "
+                             f"pairs, got {list(self.config.backends)}")
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        #: the failover schedule: exactly one retry, no backoff sleep —
+        #: the replacement replica is immediately available or the
+        #: request should surface, and the deadline (not a sleep curve)
+        #: bounds the whole operation
+        self._retry = resilience.RetryPolicy(max_attempts=2)
+        self._inflight = threading.Semaphore(self.config.max_inflight)
+        self._stop_requested = threading.Event()
+        self._draining = threading.Event()
+        self._reload_lock = threading.Lock()
+        self._reload_state: Dict[str, Any] = {"active": False}
+        self.start_time = time.perf_counter()
+        self.request_count = 0
+        self.shed_count = 0
+        self.failover_count = 0
+        # uniform daemon observability surface (idempotent)
+        from predictionio_tpu.common import devicewatch, slo
+        devicewatch.install()
+        slo.install()
+        reg = telemetry.registry()
+        self._m_requests = reg.counter(
+            "pio_router_requests_total",
+            "Routed /queries.json requests by outcome (ok / failover_ok "
+            "/ shed / deadline / error)", labelnames=("outcome",))
+        self._m_failovers = reg.counter(
+            "pio_router_failovers_total",
+            "Forwards retried on another replica after a transport "
+            "failure or timeout on the first").child()
+        self._m_overhead = reg.histogram(
+            "pio_router_overhead_seconds",
+            "Router-added latency per request: handler time minus the "
+            "backend call itself (selection + header assembly + "
+            "serialization)",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.05, float("inf"))).child()
+        self._m_backend_up = reg.gauge(
+            "pio_router_backend_up",
+            "1 while this backend is in rotation (healthy + admitted by "
+            "the reload barrier), 0 while ejected",
+            labelnames=("backend",))
+        # first sweep runs synchronously so a router that starts against
+        # a live fleet is ready the moment its own /readyz answers
+        self._poll_once(timeout=min(2.0, self.config.health_ms / 1e3 * 4))
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="pio-router-health", daemon=True)
+        self._poller.start()
+
+    # ----------------------------------------------------------- membership
+    def _poll_once(self, timeout: float = 2.0) -> None:
+        for b in self.backends:
+            healthy, draining, gen = b.probe(timeout=timeout)
+            with self._lock:
+                was = b.healthy
+                b.healthy = healthy
+                b.draining = draining
+                if gen is not None:
+                    b.generation = gen
+            if healthy and not was:
+                journal.emit(
+                    "router", f"backend {b.name} re-admitted "
+                    f"(readiness probe recovered, generation {gen})",
+                    level=journal.INFO, backend=b.name,
+                    generation=gen)
+            elif was and not healthy:
+                # drop the idle keep-alive pool: sockets to an ejected
+                # replica are stale at best
+                b.close()
+                journal.emit(
+                    "router", f"backend {b.name} ejected from rotation "
+                    + ("(draining)" if draining
+                       else "(readiness probe failed)"),
+                    level=(journal.WARN if draining else journal.RED),
+                    backend=b.name, draining=draining)
+            self._m_backend_up.labels(backend=b.name).set(
+                1.0 if (healthy and b.admitted) else 0.0)
+
+    def _poll_loop(self) -> None:
+        interval = self.config.health_ms / 1e3
+        while not self._stop_requested.is_set():
+            if self._stop_requested.wait(interval):
+                return
+            try:
+                self._poll_once(timeout=max(interval * 4, 0.5))
+            except Exception:
+                logger.exception("health poll sweep failed")
+
+    def note_backend_failure(self, b: _Backend) -> None:
+        """A forwarded request failed in transport: eject immediately
+        instead of waiting out the poll interval (the poller re-admits
+        on the next successful probe)."""
+        with self._lock:
+            was = b.healthy
+            b.healthy = False
+        if was:
+            journal.emit(
+                "router", f"backend {b.name} ejected from rotation "
+                "(forwarded request failed in transport)",
+                level=journal.RED, backend=b.name)
+            self._m_backend_up.labels(backend=b.name).set(0.0)
+
+    def _eligible(self) -> List[_Backend]:
+        with self._lock:
+            return [b for b in self.backends if b.healthy and b.admitted]
+
+    def _pick(self, exclude: Optional[set] = None) -> Optional[_Backend]:
+        """Round-robin over the rotation, skipping excluded backends and
+        open breakers."""
+        eligible = [b for b in self._eligible()
+                    if not exclude or b.name not in exclude]
+        if not eligible:
+            return None
+        start = next(self._rr)
+        for k in range(len(eligible)):
+            b = eligible[(start + k) % len(eligible)]
+            try:
+                b.breaker.allow()
+            except resilience.CircuitOpenError:
+                continue
+            return b
+        return None
+
+    # ------------------------------------------------------------ dispatch
+    def handle(self, method: str, path: str,
+               query: Optional[Dict[str, str]] = None,
+               body: bytes = b"",
+               headers: Optional[Dict[str, str]] = None) -> Response:
+        method = method.upper()
+        path = (path or "/").rstrip("/") or "/"
+        try:
+            if path == "/" and method == "GET":
+                return 200, self._status()
+            if path == "/healthz" and method == "GET":
+                return 200, {"status": "ok"}
+            if path == "/readyz" and method == "GET":
+                return self._readyz()
+            t = telemetry.handle_route(
+                method, path, query,
+                accept=(headers or {}).get("accept")
+                or (headers or {}).get("Accept"))
+            if t is not None:
+                return t
+            if path == "/queries.json" and method == "POST":
+                return self._queries(body, headers or {})
+            if path == "/reload" and method == "POST":
+                return self._start_reload(query or {})
+            if path == "/stop" and method == "POST":
+                self._stop_requested.set()
+                return 200, {"message": "Shutting down."}
+            return 404, {"message": "Not Found"}
+        except Exception as e:
+            logger.exception("router request failed: %s %s", method, path)
+            return 500, {"message": str(e)}
+
+    def _status(self) -> Dict[str, Any]:
+        with self._lock:
+            backends = [b.state() for b in self.backends]
+        gens = {b["generation"] for b in backends
+                if b["generation"] is not None}
+        return {
+            "status": "alive",
+            "router": True,
+            "backends": backends,
+            "inRotation": sum(1 for b in backends if b["inRotation"]),
+            "generations": sorted(gens),
+            "generationSkew": len(gens) > 1,
+            "requestCount": self.request_count,
+            "shedCount": self.shed_count,
+            "failoverCount": self.failover_count,
+            "reload": dict(self._reload_state),
+            "draining": self._draining.is_set(),
+        }
+
+    def _readyz(self) -> Response:
+        """Ready while at least one backend is in rotation — the router's
+        own upstream (an external LB or DNS) steers elsewhere when the
+        whole fleet is dark or this router drains."""
+        if self._draining.is_set():
+            return 503, {"status": "draining"}
+        eligible = self._eligible()
+        payload = {
+            "status": "ready" if eligible else "unready",
+            "backendsInRotation": len(eligible),
+            "backendsTotal": len(self.backends),
+        }
+        return (200 if eligible else 503), payload
+
+    # ----------------------------------------------------------- query path
+    def _budget_s(self, headers: Dict[str, str]) -> float:
+        """The request's deadline budget in seconds: the router default,
+        or a smaller client-propagated X-PIO-Deadline-Ms."""
+        budget = self.config.deadline_ms / 1e3
+        raw = None
+        for k, v in headers.items():
+            if k.lower() == "x-pio-deadline-ms":
+                raw = v
+                break
+        if raw is not None:
+            try:
+                client_ms = float(raw)
+                if 0 <= client_ms / 1e3 < budget:
+                    budget = client_ms / 1e3
+            except ValueError:
+                pass
+        return budget
+
+    def _queries(self, body: bytes, headers: Dict[str, str]) -> Response:
+        t_start = time.perf_counter()
+        if self._draining.is_set():
+            return 503, {"message": "router is draining"}, \
+                {"Retry-After": "1"}
+        if not self._inflight.acquire(blocking=False):
+            # admission control: the fleet is saturated end to end;
+            # queueing here would only grow latency without bound
+            self._shed("inflight")
+            return 503, {"message": (
+                "router is saturated (admission control); retry later")}, \
+                {"Retry-After": "1"}
+        try:
+            return self._forward(body, headers, t_start)
+        finally:
+            self._inflight.release()
+
+    def _shed(self, reason: str) -> None:
+        with self._lock:
+            self.shed_count += 1
+        if telemetry.on():
+            self._m_requests.labels(outcome="shed").inc()
+        logger.warning("router shed a query (%s)", reason)
+
+    def _forward(self, body: bytes, headers: Dict[str, str],
+                 t_start: float) -> Response:
+        deadline = t_start + self._budget_s(headers)
+        fwd_headers = {"Content-Type": "application/json"}
+        ctx = tracing.current()
+        if ctx is not None:
+            # the transport adopted (or originated) this request's trace;
+            # propagating it is what lets `pio trace` assemble the
+            # router->replica tree
+            fwd_headers[tracing.TRACE_HEADER] = ctx.header_value()
+        attempt = 0
+        backend_s = 0.0
+        exclude: set = set()
+        failed_over = False
+        while True:
+            b = self._pick(exclude)
+            if b is None:
+                self._shed("no backend in rotation")
+                return 503, {"message": (
+                    "no healthy backend in rotation; retry later")}, \
+                    {"Retry-After": "1"}
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                if telemetry.on():
+                    self._m_requests.labels(outcome="deadline").inc()
+                return 504, {"message": "deadline exceeded"}
+            # while a failover retry is still possible, reserve half the
+            # remaining budget for it: a replica slower than half the
+            # budget TIMES OUT here (a breaker-visible failure — this is
+            # how injected latency on one replica shifts traffic) and
+            # the retry still has room to succeed elsewhere. The last
+            # attempt gets everything that is left.
+            attempt_timeout = (
+                remaining / 2
+                if self._retry.may_retry(attempt, deadline,
+                                         clock=time.perf_counter)
+                and len(self._eligible()) > 1
+                else remaining)
+            hdrs = {**fwd_headers,
+                    "X-PIO-Deadline-Ms": str(int(attempt_timeout * 1e3))}
+            t0 = time.perf_counter()
+            try:
+                if ctx is not None:
+                    with tracing.span("route", service=b.name):
+                        status, payload, rheaders = b.request(
+                            "POST", "/queries.json", body, hdrs,
+                            timeout=attempt_timeout)
+                else:
+                    status, payload, rheaders = b.request(
+                        "POST", "/queries.json", body, hdrs,
+                        timeout=attempt_timeout)
+            except _TRANSPORT_ERRORS as e:
+                backend_s += time.perf_counter() - t0
+                b.breaker.record(False)
+                self.note_backend_failure(b)
+                exclude.add(b.name)
+                # /queries.json is a pure read: ONE failover retry on
+                # another replica is safe; a second failure surfaces
+                if self._retry.may_retry(attempt, deadline,
+                                         clock=time.perf_counter):
+                    attempt += 1
+                    failed_over = True
+                    with self._lock:
+                        self.failover_count += 1
+                    if telemetry.on():
+                        self._m_failovers.inc()
+                    continue
+                if telemetry.on():
+                    self._m_requests.labels(outcome="error").inc()
+                return 502, {"message": (
+                    f"backend {b.name} failed ({type(e).__name__}) and "
+                    "the failover budget is spent")}
+            backend_s += time.perf_counter() - t0
+            b.breaker.record(status < 500)
+            if status in (502, 503, 504) and self._retry.may_retry(
+                    attempt, deadline, clock=time.perf_counter):
+                # a draining/saturated replica said "not me" — that is
+                # exactly the failover case; its Retry-After floor only
+                # matters if the retry fails too
+                attempt += 1
+                failed_over = True
+                exclude.add(b.name)
+                with self._lock:
+                    self.failover_count += 1
+                if telemetry.on():
+                    self._m_failovers.inc()
+                continue
+            return self._respond(status, payload, rheaders, failed_over,
+                                 t_start, backend_s)
+
+    def _respond(self, status: int, payload: bytes,
+                 rheaders: Dict[str, str], failed_over: bool,
+                 t_start: float, backend_s: float) -> Response:
+        try:
+            obj = json.loads(payload) if payload else {}
+        except ValueError:
+            if telemetry.on():
+                self._m_requests.labels(outcome="error").inc()
+            return 502, {"message": "backend returned a non-JSON reply"}
+        extra: Dict[str, str] = {}
+        if rheaders.get("retry-after"):
+            extra["Retry-After"] = rheaders["retry-after"]
+        with self._lock:
+            self.request_count += 1
+        if telemetry.on():
+            outcome = ("error" if status >= 500
+                       else "failover_ok" if failed_over else "ok")
+            self._m_requests.labels(outcome=outcome).inc()
+            # added latency = our handler time minus the backend call —
+            # both clocks end host-side in this pure-Python path
+            self._m_overhead.observe(
+                max(time.perf_counter() - t_start - backend_s, 0.0))
+        if extra:
+            return status, obj, extra
+        return status, obj
+
+    # --------------------------------------------------- hot-swap barrier
+    def _start_reload(self, query: Dict[str, str]) -> Response:
+        """Kick (or join, with ?wait=1) the coordinated reload barrier.
+        One barrier at a time: a second POST while one runs answers 409
+        (two interleaved barriers could split the fleet's generations)."""
+        if not self._reload_lock.acquire(blocking=False):
+            return 409, {"message": "a reload barrier is already running"}
+        wait = (query.get("wait") or "") in ("1", "true", "yes")
+        done = threading.Event()
+
+        def run():
+            try:
+                self._reload_barrier()
+            finally:
+                self._reload_lock.release()
+                done.set()
+
+        threading.Thread(target=run, name="pio-router-reload",
+                         daemon=True).start()
+        if wait:
+            done.wait(300.0)
+            return 200, {"message": "Reload barrier finished.",
+                         "reload": dict(self._reload_state)}
+        return 200, {"message": "Reload barrier started."}
+
+    def _await_flip(self, b: _Backend, old_gen: Optional[int],
+                    timeout_s: float = 120.0) -> bool:
+        """Poll one backend until its generation moves past ``old_gen``
+        AND it is ready again."""
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            healthy, _draining, gen = b.probe()
+            with self._lock:
+                if gen is not None:
+                    b.generation = gen
+                b.healthy = healthy
+            if healthy and gen is not None and (
+                    old_gen is None or gen > old_gen):
+                return True
+            time.sleep(min(self.config.health_ms / 1e3, 0.2))
+        return False
+
+    def _set_admitted(self, backends: List[_Backend], value: bool) -> None:
+        with self._lock:
+            for b in backends:
+                b.admitted = value
+        for b in backends:
+            self._m_backend_up.labels(backend=b.name).set(
+                1.0 if (b.healthy and value) else 0.0)
+
+    def _reload_barrier(self) -> None:
+        """The coordinated hot-swap: reload replicas one at a time while
+        queries route only to old-generation replicas, then cut over
+        atomically. On a failed replica reload the barrier ABORTS and
+        re-admits everything — the fleet then has mixed generations
+        until the operator re-runs /reload (journaled RED; doctor WARNs
+        on the skew; KNOWN_ISSUES #15 records the contract)."""
+        t0 = time.perf_counter()
+        old = self._eligible()
+        self._reload_state = {"active": True, "flipped": 0,
+                              "total": len(old)}
+        journal.emit(
+            "router", f"reload barrier begin over {len(old)} backend(s)",
+            level=journal.INFO, backends=[b.name for b in old])
+        if not old:
+            self._reload_state = {"active": False, "error":
+                                  "no backend in rotation"}
+            journal.emit("router", "reload barrier aborted: no backend "
+                         "in rotation", level=journal.WARN)
+            return
+
+        def reload_one(b: _Backend) -> bool:
+            old_gen = b.generation
+            try:
+                status, _p, _h = b.request("POST", "/reload", b"", {},
+                                           timeout=10.0)
+            except _TRANSPORT_ERRORS as e:
+                journal.emit(
+                    "router", f"reload of {b.name} failed in transport: "
+                    f"{type(e).__name__}", level=journal.RED,
+                    backend=b.name)
+                return False
+            if status != 200:
+                journal.emit(
+                    "router", f"reload of {b.name} answered {status}",
+                    level=journal.RED, backend=b.name, status=status)
+                return False
+            return self._await_flip(b, old_gen)
+
+        if len(old) == 1:
+            # a single replica's in-process hot-swap is already atomic
+            # and zero-downtime; pulling it from rotation would be the
+            # only way to DROP queries here
+            ok = reload_one(old[0])
+            self._reload_state = {"active": False, "flipped": int(ok),
+                                  "total": 1, "ok": ok}
+            journal.emit(
+                "router",
+                "reload barrier complete (single backend, in-place "
+                "hot-swap)" if ok else
+                "reload barrier FAILED on the single backend",
+                level=journal.INFO if ok else journal.RED,
+                durationS=round(time.perf_counter() - t0, 3))
+            return
+
+        flipped: List[_Backend] = []
+        for b in old[:-1]:
+            # hold this replica out; traffic stays on old-generation
+            # replicas (flipped ones wait un-admitted for the cutover)
+            self._set_admitted([b], False)
+            if not reload_one(b):
+                # abort: re-admit everything (mixed generations beat a
+                # shrinking fleet — the skew is visible and re-runnable)
+                self._set_admitted(flipped + [b], True)
+                self._reload_state = {"active": False,
+                                      "flipped": len(flipped),
+                                      "total": len(old), "ok": False,
+                                      "error": f"reload of {b.name} failed"}
+                journal.emit(
+                    "router", "reload barrier ABORTED: fleet has mixed "
+                    "generations until /reload is re-run",
+                    level=journal.RED, failed=b.name)
+                return
+            flipped.append(b)
+            self._reload_state["flipped"] = len(flipped)
+        last = old[-1]
+        # THE cutover: one lock-held flip admits every new-generation
+        # replica and retires the lone old one — queries admitted before
+        # this line answered from the old generation, after it from the
+        # new; no interleaving
+        with self._lock:
+            for b in flipped:
+                b.admitted = True
+            last.admitted = False
+        for b in flipped + [last]:
+            self._m_backend_up.labels(backend=b.name).set(
+                1.0 if (b.healthy and b.admitted) else 0.0)
+        journal.emit(
+            "router", f"reload barrier cutover: {len(flipped)} backend(s) "
+            f"now serving the new generation; reloading {last.name}",
+            level=journal.INFO, flipped=[b.name for b in flipped])
+        ok = reload_one(last)
+        self._set_admitted([last], True)
+        self._reload_state = {"active": False,
+                              "flipped": len(flipped) + int(ok),
+                              "total": len(old), "ok": ok}
+        journal.emit(
+            "router",
+            f"reload barrier complete over {len(old)} backend(s)" if ok
+            else f"reload barrier FAILED on the last backend {last.name}; "
+            "it re-admits when its probe recovers",
+            level=journal.INFO if ok else journal.RED,
+            durationS=round(time.perf_counter() - t0, 3))
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @draining.setter
+    def draining(self, value: bool) -> None:
+        if value:
+            self.drain()
+
+    def drain(self) -> None:
+        """Stop admitting (readyz -> 503, queries -> 503 + Retry-After);
+        in-flight forwards finish on the transport's own drain."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        journal.emit("router", "router drain begin: stopped admitting "
+                     "queries", level=journal.INFO)
+        self._stop_requested.set()
+
+    def close(self) -> None:
+        self._stop_requested.set()
+        for b in self.backends:
+            b.close()
+
+
+def serve(api: RouterAPI, host: str = "localhost",
+          port: int = 8100) -> None:
+    """Run the router until /stop or SIGTERM (graceful drain: readiness
+    flips, in-flight forwards complete, then exit) on the shared
+    transport."""
+    from predictionio_tpu.data.api.http import (
+        install_sigterm_handler, make_server,
+    )
+    server = make_server(api, host, port)
+    install_sigterm_handler(api.drain)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    logger.info("Router online at http://%s:%s over %d backend(s)",
+                host, port, len(api.backends))
+    try:
+        while not api.stop_requested:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    server.shutdown()
+    server.server_close()
+    api.close()

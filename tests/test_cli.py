@@ -115,6 +115,27 @@ def test_dashboard_lists_completed_evaluations(memory_storage):
     assert api.handle("GET", "/engine_instances/zzz.json")[0] == 404
 
 
+def test_router_cli_surface(capsys):
+    """`pio router` parses its fleet flags and refuses an empty backend
+    list with the reference-style one-liner (exit 1, no traceback)."""
+    from predictionio_tpu.tools.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["router", "--backends", "http://a:8000,http://b:8000",
+         "--port", "8123", "--health-ms", "250", "--deadline-ms", "900",
+         "--max-inflight", "64"])
+    assert args.command == "router"
+    assert args.backends == "http://a:8000,http://b:8000"
+    assert (args.port, args.health_ms, args.deadline_ms,
+            args.max_inflight) == (8123, 250.0, 900.0, 64)
+    # doctor grows the fleet sweep flag
+    args = build_parser().parse_args(
+        ["doctor", "--targets", "http://r:8100,http://q:8000"])
+    assert args.targets == "http://r:8100,http://q:8000"
+    assert main(["router", "--backends", " , "]) == 1
+    assert "--backends" in capsys.readouterr().err
+
+
 def test_quickstart_lifecycle(tmp_path, capsys, memory_storage, monkeypatch):
     """pio app new -> events via REST -> pio train -> deploy -> query
     (quickstart_test.py:50-140)."""

@@ -286,6 +286,81 @@ def test_memory_cursor_surface(memory_storage):
     assert ev.head_cursor(1) == 2
 
 
+@pytest.fixture()
+def remote_events(el_events):
+    """The eventlog store served over a live storage server, consumed
+    through the `remote` driver — the fold-in backend matrix's last
+    open row (ISSUE 15 satellite)."""
+    from predictionio_tpu.data.storage.remote import serve_storage
+
+    storage, ev = el_events
+    server = serve_storage(storage, host="127.0.0.1", port=0)
+    remote = Storage(env={
+        "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_R_URL":
+            f"http://127.0.0.1:{server.server_address[1]}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+    })
+    yield ev, remote.get_events()
+    server.shutdown()
+    server.server_close()
+
+
+def test_remote_cursor_tail_matches_backend(remote_events):
+    """The remote driver's cursor tail (proto 3: head_cursor /
+    cursor_lag DAO calls + the binary /rpc/read_columns_since route)
+    answers byte-identically to the backing eventlog store."""
+    backend, ev = remote_events
+    assert ev.cursor_tail_supported()
+    backend.insert_batch([_mk_event("u1", "i1", 5.0),
+                          _mk_event("u2", "i2", 3.0)], 1)
+    head = ev.head_cursor(1)
+    assert head == backend.head_cursor(1)
+    assert ev.cursor_lag(1, cursor={"seq": 0, "row": 0}) == 2
+    assert ev.cursor_lag(1, cursor=head) == 0
+    backend.insert_batch([_mk_event("u3", "i3", 1.0)], 1)
+    cur, cols = ev.read_columns_since(
+        1, cursor=head, event_names=["rate", "buy"],
+        entity_type="user", target_entity_type="item")
+    d_cur, d_cols = backend.read_columns_since(
+        1, cursor=head, event_names=["rate", "buy"],
+        entity_type="user", target_entity_type="item")
+    assert cur == d_cur
+    assert cols["pool"] == d_cols["pool"]
+    for key in ("entity_code", "target_code", "event_code", "rating",
+                "time_ms", "creation_ms"):
+        np.testing.assert_array_equal(cols[key], d_cols[key])
+    assert [cols["pool"][c] for c in cols["entity_code"]] == ["u3"]
+
+
+def test_remote_foldin_tail_selected(remote_events):
+    """The fold-in worker no longer refuses a remote-backed deployment:
+    tail_for picks the forwarded columnar cursor tail — and an OLD
+    storage server (proto < 3) still refuses cleanly at bind time."""
+    from predictionio_tpu.realtime import foldin
+
+    backend, ev = remote_events
+    backend.insert_batch([_mk_event("u1", "i1", 5.0)], 1)
+    cfg = foldin.FoldinConfig(app_name=APP)
+    tail = foldin.tail_for(ev, 1, cfg)
+    assert tail is not None and tail.kind == "columnar"
+    cur, rows = tail.read({"seq": 0, "row": 0})
+    assert [(r[0], r[1], r[2], r[3]) for r in rows] == \
+        [("u1", "i1", "rate", 5.0)]
+    assert tail.lag(cur) == 0
+    backend.insert_batch([_mk_event("u9", "i1", 4.0)], 1)
+    assert tail.lag(cur) == 1
+    _cur2, rows2 = tail.read(cur)
+    assert [r[0] for r in rows2] == ["u9"]
+    # an old server: the feature probe says no, the worker refuses at
+    # bind time instead of failing per tick
+    ev.c._proto = 2
+    assert not ev.cursor_tail_supported()
+    assert foldin.tail_for(ev, 1, cfg) is None
+
+
 # ---------------------------------------------------------------------------
 # solve-kernel parity vs an independent numpy half-step
 # ---------------------------------------------------------------------------

@@ -402,6 +402,9 @@ _OLD_AOT_MODULES = ("ops/topk.py", "parallel/serve_dist.py")  # + serving/*
 _OLD_DAEMON_MODULES = (
     "workflow/create_server.py", "data/api/service.py",
     "data/storage/remote.py",
+    # PR 15: the fleet router is a fourth daemon with the same shared
+    # debug surface contract
+    "workflow/router.py",
 )
 
 

@@ -666,7 +666,7 @@ def test_responses_byte_identical_with_telemetry_on_and_off(memory_storage):
         assert set(info) == {
             "status", "engineInstance", "algorithms", "requestCount",
             "avgServingSec", "lastServingSec", "degradedCount", "draining",
-            "serverStartTime", "batching", "aot"}
+            "serverStartTime", "generation", "batching", "aot"}
     finally:
         telemetry.set_enabled(None)
         api.close()

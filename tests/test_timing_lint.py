@@ -68,19 +68,34 @@ def test_debug_paths_parse_from_telemetry_source():
 
 def test_debug_paths_answer_on_event_and_storage_daemons(memory_storage):
     """Runtime half of the lint: every DEBUG_PATHS surface answers
-    (non-404) on the two cheap daemons. The query server's identical
-    surface is covered by the waterfall e2e test (it needs a trained
-    model)."""
+    (non-404) on the cheap daemons — the event server, the storage
+    server, and the fleet router (a backendless one constructs fine;
+    its debug surface is independent of the fleet's health). The query
+    server's identical surface is covered by the waterfall e2e test
+    (it needs a trained model)."""
+    import socket
+
     from predictionio_tpu.common import telemetry
     from predictionio_tpu.data.api import EventAPI
     from predictionio_tpu.data.storage.remote import StorageRPCAPI
+    from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    router = RouterAPI(RouterConfig(
+        backends=(f"http://127.0.0.1:{dead_port}",), health_ms=50.0))
     apis = (EventAPI(storage=memory_storage),
-            StorageRPCAPI(memory_storage, key="sekrit"))
-    for api in apis:
-        for path in telemetry.DEBUG_PATHS:
-            response = api.handle("GET", path)
-            assert response[0] == 200, (type(api).__name__, path,
-                                        response)
+            StorageRPCAPI(memory_storage, key="sekrit"),
+            router)
+    try:
+        for api in apis:
+            for path in telemetry.DEBUG_PATHS:
+                response = api.handle("GET", path)
+                assert response[0] == 200, (type(api).__name__, path,
+                                            response)
+    finally:
+        router.close()
 
 
 if __name__ == "__main__":

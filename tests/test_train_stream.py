@@ -253,6 +253,47 @@ def test_front_door_streamed_equals_incore(tmp_path, monkeypatch):
     assert m_off.user_vocab.to_dict() == m_on.user_vocab.to_dict()
 
 
+def test_write_events_streams_bounded_batches(memory_storage):
+    """The Event-object fallback of write_events (backends without the
+    bulk columnar append) streams bounded insert_batch calls instead of
+    materializing a whole chunk of Event objects in-core — the PR 14
+    ROADMAP follow-up. Structural half: no insert ever exceeds the
+    batch bound even when the chunk is much larger. RSS half: the
+    process high-water mark moves by at most a modest constant while
+    writing, not by O(chunk) of Event objects."""
+    from predictionio_tpu.common.devicewatch import host_memory_stats
+    from predictionio_tpu.data.storage import App
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "SynIngest"))
+    ev = memory_storage.get_events()
+    assert not hasattr(ev, "append_encoded")   # the fallback path
+    seen = []
+    real_insert = ev.insert_batch
+
+    def counting_insert(events, app, channel=None):
+        seen.append(len(events))
+        return real_insert(events, app, channel)
+
+    ev.insert_batch = counting_insert
+    try:
+        src = synthetic.chunk_source(20_000, seed=5, chunk=1 << 14)
+        before = host_memory_stats().get("peakRssBytes")
+        total = synthetic.write_events(src, memory_storage, app_id,
+                                       batch=1024)
+        after = host_memory_stats().get("peakRssBytes")
+    finally:
+        ev.insert_batch = real_insert
+    assert total == 20_000
+    assert sum(seen) == 20_000
+    # the chunk (16384 events) never materializes at once: every insert
+    # is at most the batch bound
+    assert max(seen) <= 1024
+    if before is not None and after is not None:
+        # generous ceiling — the stored events themselves are O(N), but
+        # a whole-chunk Event materialization would add hundreds of MB
+        assert after - before < 200 * 2**20, (before, after)
+
+
 def test_synthetic_cli_flags(monkeypatch):
     from predictionio_tpu.tools.cli import _apply_read_env, build_parser
 
@@ -402,7 +443,14 @@ def test_host_rss_in_debug_snapshot(monkeypatch):
 # the scale soak (slow; kept out of tier-1) + its tier-1-scale smoke
 # ---------------------------------------------------------------------------
 
-def _soak(n_events: int, budget_bytes: int):
+def _soak(n_events: int, budget_bytes: int, relative: bool = False):
+    """``relative=True`` bounds the pipeline's GROWTH over the run
+    (peak minus the watcher's first sample) instead of the absolute
+    process footprint — the tier-1 smoke shares one long-lived pytest
+    process whose baseline heap grows with every test added to the
+    suite, which is suite length, not pipeline memory. The 1 B soak
+    keeps the absolute bound: it runs deliberately, in a process whose
+    RSS the pipeline dominates."""
     from predictionio_tpu.common import devicewatch
     from predictionio_tpu.ops import als
 
@@ -420,21 +468,25 @@ def _soak(n_events: int, budget_bytes: int):
         import jax
         jax.device_get((U[-1:], V[-1:]))
     assert np.isfinite(np.asarray(U[-1:])).all()
-    assert w.peak_pipeline <= budget_bytes, (
-        f"streamed train peak pipeline RSS {w.peak_pipeline / 2**30:.2f} "
+    measured = w.peak_pipeline - ((w.baseline_pipeline or 0)
+                                  if relative else 0)
+    assert measured <= budget_bytes, (
+        f"streamed train peak pipeline RSS "
+        f"{'growth ' if relative else ''}{measured / 2**30:.2f} "
         f"GiB exceeds the {budget_bytes / 2**30:.1f} GiB O(chunk) budget")
     return w, src
 
 
 def test_streamed_smoke_pipeline_rss_bounded():
     """Tier-1-scale streamed smoke: the full stream→stage→layout→train
-    pipeline runs and the peak PIPELINE host RSS (RSS minus live jax
-    bytes — KNOWN_ISSUES #14) stays inside the 4 GB soak budget, which
-    at this scale is trivially loose; the 1 B soak below tightens it
-    against a dataset 3 orders of magnitude past it."""
+    pipeline runs and the peak PIPELINE host RSS growth (RSS minus live
+    jax bytes, minus the shared test process's baseline —
+    KNOWN_ISSUES #14) stays inside a 2 GB budget, trivially loose at
+    this scale; the 1 B soak below tightens the ABSOLUTE bound against
+    a dataset 3 orders of magnitude past it in a dedicated process."""
     if os.name != "posix" or not os.path.exists("/proc/self/status"):
         pytest.skip("needs /proc for RSS accounting")
-    _soak(300_000, budget_bytes=4 << 30)
+    _soak(300_000, budget_bytes=2 << 30, relative=True)
 
 
 @pytest.mark.slow
