@@ -1957,6 +1957,249 @@ def measure_router(n_conns: int = 8, queries_per_client: int = 60):
     return out
 
 
+def measure_multitenant(n_conns: int = 6, queries_per_client: int = 50,
+                        flood_threads: int = 4):
+    """Multi-tenant serving leg (serving/registry.py + the --engines
+    deploy path): ONE process hosting N engine instances, measured on
+    its two headline claims:
+
+    - **shared-AOT compile flatness** — a 4-tenant deploy compiles
+      exactly as many XLA programs as a 1-tenant deploy (later tenants
+      memoize); ``mt_compile_count_4t`` vs ``mt_compile_count_1t``,
+      strict-gated equal everywhere (compiling is deterministic);
+    - **noisy-neighbor isolation** — tenant B's p99 while tenant A is
+      flooded into its own small queue, over B's solo p99:
+      ``mt_isolation_p99_ratio``, strict-gated <= 3x on >= 4-core
+      hosts (on a shared core the flooders fight B for CPU and the
+      ratio measures the host; ``mt_gate_capable`` records the skip).
+
+    The leg runs on its own storage so its small per-tenant models
+    never become the bench storage's latest COMPLETED instance."""
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.data.storage import AccessKey, App, Storage
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from predictionio_tpu.serving import aot
+    from predictionio_tpu.serving.registry import TenantSpec
+    from predictionio_tpu.workflow import run_train
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.create_server import (
+        QueryAPI, ServerConfig,
+    )
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    capable = cores >= 4
+    workdir = tempfile.mkdtemp(prefix="pio_mt_bench_")
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": os.path.join(workdir, "el"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    import datetime as _dt
+
+    n_tenants = 4
+    specs_src = []
+    for t in range(1, n_tenants + 1):
+        app_name = f"MTBench{t}"
+        app_id = storage.get_meta_data_apps().insert(App(0, app_name))
+        storage.get_events().init(app_id)
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(f"mt-key-{t}", app_id, ()))
+        rng = np.random.default_rng(20 + t)
+        events = []
+        for u in range(64):
+            for i in rng.choice(48, size=12, replace=False).tolist():
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": float(1 + (u * 7 + i + t) % 5)}),
+                    event_time=_dt.datetime(
+                        2021, 1, 1, tzinfo=_dt.timezone.utc)))
+        storage.get_events().insert_batch(events, app_id)
+        iid = run_train(
+            WorkflowContext(storage=storage), RecommendationEngine(),
+            EngineParams(
+                data_source_params=DataSourceParams(appName=app_name),
+                algorithm_params_list=(("als", ALSAlgorithmParams(
+                    rank=8, numIterations=3, lambda_=0.05,
+                    seed=30 + t)),)),
+            engine_factory=("predictionio_tpu.models.recommendation"
+                            ":RecommendationEngine"),
+            params_json={
+                "datasource": {"params": {"appName": app_name}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 8, "numIterations": 3, "lambda": 0.05,
+                    "seed": 30 + t}}]})
+        specs_src.append((f"t{t}", f"mt-key-{t}", iid))
+
+    def specs(n, **overrides):
+        return tuple(TenantSpec(
+            name=name, access_key=key, engine_instance_id=iid,
+            **overrides.get(name, {}))
+            for name, key, iid in specs_src[:n])
+
+    out: dict = {"mt_gate_capable": capable, "mt_tenants": n_tenants}
+    api = server = None
+    try:
+        # --- shared-AOT compile flatness: 1 tenant vs 4 tenants ------
+        def compile_counts(n):
+            aot.reset_memo()
+            a = QueryAPI(storage=storage, config=ServerConfig(
+                batching="on", aot="on", tenants=specs(n)))
+            try:
+                states = [a.registry.get(name).aot_state
+                          for name, _k, _i in specs_src[:n]]
+                if not all(s and s.get("enabled") for s in states):
+                    raise RuntimeError("AOT did not enable for every "
+                                       "tenant servable")
+                return [int(s["compiled"]) for s in states]
+            finally:
+                a.close()
+
+        c1 = compile_counts(1)
+        c4 = compile_counts(n_tenants)
+        out["mt_compile_count_1t"] = sum(c1)
+        out[f"mt_compile_count_{n_tenants}t"] = sum(c4)
+        out["mt_compile_flat_ok"] = bool(
+            sum(c1) > 0 and sum(c4) == sum(c1))
+
+        # --- noisy-neighbor isolation: flood t1, measure t2 ----------
+        # t1 gets a deliberately small queue so the flood saturates IT
+        # (tenant-scoped 503s), not the process; AOT off — flatness is
+        # already measured and the pump only needs steady answers
+        aot.reset_memo()
+        api = QueryAPI(storage=storage, config=ServerConfig(
+            batching="on", aot="off",
+            tenants=specs(2, t1={"batch_max_queue": 8})))
+        from predictionio_tpu.data.api.http import serve_background
+        server, port = serve_background(api)
+
+        def pump(key):
+            """n_conns keep-alive clients x queries_per_client keyed
+            requests; returns (qps, p50_ms, p99_ms)."""
+            lat_lock = threading.Lock()
+            lat: list = []
+            errors: list = []
+            barrier = threading.Barrier(n_conns + 1)
+            path = f"/queries.json?accessKey={key}"
+
+            def client(cx):
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    my = []
+                    barrier.wait()
+                    for q in range(queries_per_client):
+                        body = json.dumps(
+                            {"user": f"u{(cx * 131 + q * 17) % 64}",
+                             "num": 10})
+                        t0 = time.perf_counter()
+                        conn.request(
+                            "POST", path, body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        my.append(time.perf_counter() - t0)
+                        assert resp.status == 200, payload[:200]
+                    conn.close()
+                    with lat_lock:
+                        lat.extend(my)
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(cx,))
+                       for cx in range(n_conns)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            lat_ms = np.asarray(lat) * 1e3
+            return (round(n_conns * queries_per_client / wall, 1),
+                    round(float(np.percentile(lat_ms, 50)), 3),
+                    round(float(np.percentile(lat_ms, 99)), 3))
+
+        pump("mt-key-2")   # warm every path once
+        qps_s, p50_s, p99_s = pump("mt-key-2")
+        out["mt_b_solo"] = {"qps": qps_s, "p50_ms": p50_s,
+                            "p99_ms": p99_s}
+
+        stop = threading.Event()
+        shed = [0]
+        ok_flood = [0]
+
+        def flooder():
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            body = json.dumps({"user": "u1", "num": 10})
+            while not stop.is_set():
+                try:
+                    conn.request(
+                        "POST", "/queries.json?accessKey=mt-key-1",
+                        body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status == 503:
+                        shed[0] += 1
+                    elif resp.status == 200:
+                        ok_flood[0] += 1
+                except OSError:
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+            conn.close()
+
+        floods = [threading.Thread(target=flooder)
+                  for _ in range(flood_threads)]
+        for t in floods:
+            t.start()
+        try:
+            time.sleep(0.2)   # let the flood build tenant 1's queue
+            qps_f, p50_f, p99_f = pump("mt-key-2")
+        finally:
+            stop.set()
+            for t in floods:
+                t.join()
+        out["mt_b_under_flood"] = {"qps": qps_f, "p50_ms": p50_f,
+                                   "p99_ms": p99_f}
+        out["mt_flood_503s"] = shed[0]
+        out["mt_flood_oks"] = ok_flood[0]
+        out["mt_isolation_p99_ratio"] = round(
+            p99_f / max(p99_s, 1e-9), 3)
+        out["mt_isolation_ok"] = bool(
+            out["mt_isolation_p99_ratio"] <= 3.0)
+    finally:
+        if server is not None:
+            server.shutdown()
+        if api is not None:
+            api.close()
+        try:
+            storage.get_events().close()
+        except Exception:
+            pass
+        shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
 def measure_recompile_watch(storage, engine, warmup_queries: int = 24,
                             steady_queries: int = 48):
     """Recompile-watchdog leg (common/devicewatch.py): deploy the engine
@@ -2515,6 +2758,17 @@ def main() -> None:
             except Exception as e:
                 router_leg = {"router_error": f"{type(e).__name__}: {e}"}
 
+        # multi-tenant leg (serving/registry.py): one process, N engine
+        # instances — shared-AOT compile flatness (strict everywhere)
+        # and noisy-neighbor p99 isolation (strict on >= 4-core hosts;
+        # mt_gate_capable records the honest skip)
+        mt_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                mt_leg = measure_multitenant()
+            except Exception as e:
+                mt_leg = {"multitenant_error": f"{type(e).__name__}: {e}"}
+
         # recompile-watchdog leg (common/devicewatch.py): after a warmup
         # burst the standard bucketed serving path must compile NOTHING —
         # a nonzero count is the padding-bucket p99 cliff, strict-fatal
@@ -2674,6 +2928,7 @@ def main() -> None:
                 **(shard_leg or {}),
                 **(quant_leg or {}),
                 **(router_leg or {}),
+                **(mt_leg or {}),
                 **(recompile_watch or {}),
                 **(stream_leg or {}),
                 **(eval_grid or {}),
@@ -2918,6 +3173,32 @@ def main() -> None:
                         "router 1->2 replica QPS scaling "
                         f"({router_leg.get('router_qps_scaling_2')}x) "
                         "below 1.6x with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and mt_leg:
+            if mt_leg.get("multitenant_error"):
+                failures.append(
+                    "multi-tenant leg crashed "
+                    f"({mt_leg['multitenant_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                # compile flatness is deterministic — gated on EVERY
+                # host: a 4-tenant deploy compiling more programs than
+                # a 1-tenant deploy means the shared-AOT memo broke
+                if not mt_leg.get("mt_compile_flat_ok"):
+                    failures.append(
+                        "shared-AOT compile count grew with tenant "
+                        f"count ({mt_leg.get('mt_compile_count_4t')} "
+                        f"programs at 4 tenants vs "
+                        f"{mt_leg.get('mt_compile_count_1t')} at 1) "
+                        "with BENCH_STRICT_EXTRAS=1")
+                # isolation needs real cores for the flooders
+                # (mt_gate_capable False says why the gate is skipped)
+                if mt_leg.get("mt_gate_capable") \
+                        and not mt_leg.get("mt_isolation_ok"):
+                    failures.append(
+                        "noisy-neighbor isolation: tenant B p99 grew "
+                        f"{mt_leg.get('mt_isolation_p99_ratio')}x "
+                        "under tenant A's flood (> 3x) with "
+                        "BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and stream_leg:
             if stream_leg.get("train_stream_error"):
                 failures.append(

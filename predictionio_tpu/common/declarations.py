@@ -226,6 +226,27 @@ ENV_VARS: Dict[str, str] = {
         "router admission ceiling: concurrent in-flight forwards beyond "
         "this answer 503 + Retry-After instead of queueing (default "
         "256)",
+    "PIO_ROUTER_TENANT_MAX_INFLIGHT":
+        "router per-tenant in-flight cap: concurrent forwards for one "
+        "tenant (resolved from the query's accessKey) beyond this shed "
+        "503 without charging the shared ceiling (default 0 = off)",
+    # ------------------------------------------------------ multi-tenant
+    "PIO_TENANT_RATE":
+        "default per-access-key admission rate in queries/s for "
+        "multi-tenant deploys; a tenant's conf `rate` wins (default 0 "
+        "= unlimited)",
+    "PIO_TENANT_BURST":
+        "default token-bucket burst for per-key admission; 0 derives "
+        "2x the rate (default 0)",
+    "PIO_TENANT_HBM_BUDGET_MB":
+        "default per-tenant model-bytes soft budget in MiB; a tenant "
+        "over it serves but is flagged oversubscribed (`pio doctor` "
+        "WARN); a tenant's conf `hbmBudgetMb` wins (default 0 = "
+        "unbudgeted)",
+    "PIO_TENANT_HBM_HARD_CAP_MB":
+        "process-wide model-bytes hard cap in MiB; a load that would "
+        "push the registry total past it is refused and the prior "
+        "generation keeps serving (default 0 = uncapped)",
     # -------------------------------------------------------- resilience
     "PIO_RPC_RETRIES":
         "remote-storage retry attempts for idempotent calls (default 3)",
@@ -332,7 +353,9 @@ METRICS: Dict[str, str] = {
     "pio_batcher_batch_size": "batches by exact flush size",
     "pio_batcher_bucket": "batches by padding-bucket occupancy",
     # ------------------------------------------------------------- serving
-    "pio_serve_seconds": "per-request serve latency",
+    "pio_serve_seconds":
+        "per-request serve latency by mode and tenant ('default' on a "
+        "single-tenant deploy)",
     "pio_serve_stage_seconds":
         "per-stage waterfall latency (admission/supplement/dispatch/pad/"
         "execute/merge/serialize) with trace-id exemplars",
@@ -382,7 +405,8 @@ METRICS: Dict[str, str] = {
     # -------------------------------------------------------------- router
     "pio_router_requests_total":
         "routed /queries.json requests by outcome (ok / failover_ok / "
-        "shed / deadline / error)",
+        "shed / deadline / error) and tenant ('-' for key-less "
+        "queries)",
     "pio_router_failovers_total":
         "forwards retried on another replica after a transport failure "
         "or timeout on the first",
@@ -436,6 +460,25 @@ METRICS: Dict[str, str] = {
         "error budget left, 1 = untouched (collector)",
     "pio_slo_burn_rate":
         "error rate / allowed rate over fast+slow windows (collector)",
+    "pio_slo_tenant_latency_budget_remaining":
+        "per-tenant lifetime latency error budget left (collector; "
+        "multi-tenant deploys only)",
+    # --------------------------------------------------- multi-tenant
+    "pio_tenant_requests_total":
+        "multi-tenant query outcomes by tenant (ok / saturated / "
+        "rate_limited / denied / error; '-' before admission resolved "
+        "a tenant)",
+    "pio_tenant_generation":
+        "per-tenant servable generation id (collector; multi-tenant "
+        "deploys only)",
+    "pio_tenant_queue_depth":
+        "per-tenant batcher admission queue depth (collector)",
+    "pio_tenant_model_bytes":
+        "per-tenant loaded model bytes, host-side array estimate "
+        "(collector)",
+    "pio_tenant_hbm_budget_bytes":
+        "per-tenant configured HBM soft budget (collector; only "
+        "budgeted tenants)",
 }
 
 
@@ -482,6 +525,11 @@ JOURNAL_CATEGORIES: Dict[str, str] = {
         "re-admission (info), reload-barrier begin/cutover/complete, "
         "barrier aborts leaving generation skew (red) "
         "(workflow/router.py)",
+    "tenant":
+        "multi-tenant registry events: tenant servable went live with "
+        "a generation, over-budget install (warn), hard-cap refusal, "
+        "access key unmapped to any tenant (warn) "
+        "(serving/registry.py, workflow/create_server.py)",
 }
 
 

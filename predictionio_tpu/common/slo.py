@@ -137,6 +137,33 @@ def _latency_counts(threshold_s: float) -> Tuple[float, float]:
     return good, total
 
 
+def _latency_counts_by_tenant(
+        threshold_s: float) -> Dict[str, Tuple[float, float]]:
+    """Per-tenant (good, total) from the pio_serve_seconds histogram's
+    ``tenant`` label. Empty when the family is absent or predates the
+    tenant label (a fresh test registry) — callers emit nothing then."""
+    reg = telemetry.registry()
+    with reg._lock:
+        fam = reg._families.get("pio_serve_seconds")
+    if (fam is None or fam.kind != "histogram"
+            or "tenant" not in fam.labelnames):
+        return {}
+    idx = fam.labelnames.index("tenant")
+    with fam._lock:
+        items = list(fam._children.items())
+    out: Dict[str, Tuple[float, float]] = {}
+    for key, child in items:
+        tenant = key[idx]
+        snap = child.snapshot()
+        under = 0.0
+        for ub, cum in snap["buckets"].items():
+            if ub <= threshold_s:
+                under = max(under, cum)
+        good, total = out.get(tenant, (0.0, 0.0))
+        out[tenant] = (good + under, total + snap["count"])
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the engine
 # ---------------------------------------------------------------------------
@@ -276,6 +303,22 @@ class SLOEngine:
                 lines.append(
                     f'pio_slo_burn_rate{{slo="{slo}",window="{window}"}} '
                     f'{v["burn_" + window]:.6g}')
+        # Per-tenant latency budgets (multi-tenant deploys only: a
+        # lone "default" tenant is the legacy path, whose scrape body
+        # must not grow). Lifetime-window, stateless — the windowed
+        # burn history stays per-objective, not per-tenant.
+        by_tenant = _latency_counts_by_tenant(self.config.latency_ms / 1e3)
+        if any(t != "default" for t in by_tenant):
+            allowed = max(1.0 - self.config.latency_target, 1e-9)
+            lines.append(
+                "# TYPE pio_slo_tenant_latency_budget_remaining gauge")
+            for tenant in sorted(by_tenant):
+                good, total = by_tenant[tenant]
+                bad_ratio = ((total - good) / total) if total > 0 else 0.0
+                lines.append(
+                    f'pio_slo_tenant_latency_budget_remaining'
+                    f'{{tenant="{tenant}"}} '
+                    f'{1.0 - bad_ratio / allowed:.6g}')
         return lines
 
 

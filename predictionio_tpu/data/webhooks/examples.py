@@ -156,3 +156,91 @@ class ExampleFormConnector(FormConnector):
             "eventTime": _require(data, "timestamp"),
             "properties": props,
         }
+
+
+# ---------------------------------------------------------------------------
+# reference payload fixtures for the PRODUCTION connectors
+# ---------------------------------------------------------------------------
+# One representative payload per message type of the default-registered
+# connectors (segment.io JSON, MailChimp form), shaped after the vendor
+# docs quoted in SegmentIOConnector.scala / MailChimpConnector.scala.
+# tests/test_webhooks_connectors.py iterates these to prove every type
+# converts end-to-end; new integrations can crib the shapes.
+
+_SEG_CONTEXT = {
+    "ip": "8.8.8.8",
+    "library": {"name": "analytics-python", "version": "1.0.3"},
+}
+
+#: segment.io message type -> example webhook body (JSON object)
+SEGMENTIO_EXAMPLES = {
+    "identify": {
+        "version": 2, "type": "identify", "user_id": "us1",
+        "timestamp": "2015-02-23T22:28:55.387Z",
+        "traits": {"name": "Ada", "plan": "enterprise"},
+        "context": _SEG_CONTEXT,
+    },
+    "track": {
+        "version": 2, "type": "track", "user_id": "us1",
+        "timestamp": "2015-02-23T22:28:55.111Z",
+        "event": "Registered",
+        "properties": {"plan": "Pro Annual", "accountType": "Facebook"},
+    },
+    "alias": {
+        "version": 2, "type": "alias", "user_id": "us1",
+        "timestamp": "2015-02-23T22:28:55.111Z",
+        "previous_id": "anon-42",
+    },
+    "page": {
+        "version": 2, "type": "page", "anonymous_id": "anon-42",
+        "timestamp": "2015-02-23T22:28:55.111Z",
+        "name": "Docs", "properties": {"url": "/docs"},
+    },
+    "screen": {
+        "version": 2, "type": "screen", "user_id": "us1",
+        "timestamp": "2015-02-23T22:28:55.111Z",
+        "name": "Home", "properties": {"variant": "b"},
+    },
+    "group": {
+        "version": 2, "type": "group", "user_id": "us1",
+        "timestamp": "2015-02-23T22:28:55.111Z",
+        "group_id": "grp-7", "traits": {"industry": "Technology"},
+    },
+}
+
+_MC_BASE = {
+    "fired_at": "2009-03-26 21:35:57",
+    "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+    "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+    "data[merges][EMAIL]": "api@mailchimp.com",
+    "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+    "data[merges][INTERESTS]": "Group1,Group2",
+    "data[ip_opt]": "10.20.10.30",
+}
+
+#: MailChimp callback type -> example form fields (flat key/value)
+MAILCHIMP_EXAMPLES = {
+    "subscribe": {**_MC_BASE, "type": "subscribe",
+                  "data[ip_signup]": "10.20.10.30"},
+    "unsubscribe": {**_MC_BASE, "type": "unsubscribe",
+                    "data[action]": "unsub", "data[reason]": "manual",
+                    "data[campaign_id]": "4fjk2ma9xd"},
+    "profile": {**_MC_BASE, "type": "profile"},
+    "upemail": {
+        "type": "upemail", "fired_at": "2009-03-26 22:15:09",
+        "data[list_id]": "a6b5da1054", "data[new_id]": "51da8c3259",
+        "data[new_email]": "api+new@mailchimp.com",
+        "data[old_email]": "api+old@mailchimp.com",
+    },
+    "cleaned": {
+        "type": "cleaned", "fired_at": "2009-03-26 22:01:00",
+        "data[list_id]": "a6b5da1054", "data[campaign_id]": "4fjk2ma9xd",
+        "data[reason]": "hard", "data[email]": "api+gone@mailchimp.com",
+    },
+    "campaign": {
+        "type": "campaign", "fired_at": "2009-03-26 21:31:21",
+        "data[id]": "5aa2102003", "data[list_id]": "a6b5da1054",
+        "data[subject]": "Test Campaign Subject", "data[status]": "sent",
+        "data[reason]": "",
+    },
+}

@@ -14,6 +14,9 @@ call (the dynamic-batching pattern from production inference servers):
   k) serving program set before `/readyz` flips ready, observed-bucket
   pruning, and the persistent compile cache as a deploy artifact
   (imported lazily — it pulls in the jitted kernels).
+- :mod:`registry` — the multi-tenant model registry: N
+  generation-versioned servables per process, per-tenant HBM budgets
+  with a process hard cap, and per-access-key admission (401/429).
 """
 
 from predictionio_tpu.serving.batcher import (  # noqa: F401
@@ -21,4 +24,8 @@ from predictionio_tpu.serving.batcher import (  # noqa: F401
 )
 from predictionio_tpu.serving.protocol import (  # noqa: F401
     DEFAULT_BUCKETS, batch_capable, bucket_for, pad_buckets, predict_batch,
+)
+from predictionio_tpu.serving.registry import (  # noqa: F401
+    AdmissionController, AdmissionError, ModelRegistry, ServableModel,
+    TenantSpec, load_engines_conf, parse_tenant_specs,
 )

@@ -118,6 +118,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # scaling regression is visible round over round
     ("router_added_p99_ms", "down", False),
     ("router_qps_scaling_2", "up", False),
+    # multi-tenant era (serving/registry.py): noisy-neighbor isolation
+    # — tenant B's p99 under tenant A's flood over B's solo p99
+    # (hard-gated at <= 3x by the bench's multitenant leg under
+    # BENCH_STRICT_EXTRAS=1 on >= 4-core hosts) — and the shared-AOT
+    # compile count with 4 tenants (flat vs 1 tenant, strict-gated
+    # everywhere: compiling is deterministic) — trended so isolation
+    # rot or a compile-sharing regression is visible round over round
+    ("mt_isolation_p99_ratio", "down", False),
+    ("mt_compile_count_4t", "down", False),
     # static-analysis era (tools/analyze): `pio lint` runs inside the
     # bench's strict leg; findings are gated at 0 absolutely below,
     # suppressed counts are trended so baseline debt is visible per

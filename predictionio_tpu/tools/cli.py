@@ -204,13 +204,23 @@ def cmd_deploy(args) -> int:
     )
     from predictionio_tpu.workflow.workflow_utils import read_engine_variant
     _apply_telemetry_env(args)
-    variant = read_engine_variant(os.path.abspath(args.engine_dir),
-                                  args.variant)
+    tenants = ()
+    if getattr(args, "engines", None):
+        # multi-tenant deploy (serving/registry.py): each tenant spec
+        # pins its own engine instance, so the single engine.json
+        # variant read is skipped — there is no "the" engine dir
+        from predictionio_tpu.serving.registry import load_engines_conf
+        tenants = load_engines_conf(args.engines)
+        variant = {}
+    else:
+        variant = read_engine_variant(os.path.abspath(args.engine_dir),
+                                      args.variant)
     config = ServerConfig(
         engine_instance_id=args.engine_instance_id,
         engine_dir=os.path.abspath(args.engine_dir),
         engine_id=variant.get("id", "default"),
         engine_variant=variant.get("id", "default"),
+        tenants=tenants,
         ip=args.ip, port=args.port,
         feedback=args.feedback,
         event_server_ip=args.event_server_ip,
@@ -725,6 +735,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("deploy", help="deploy the latest engine instance")
     engine_flags(sp)
     sp.add_argument("--engine-instance-id", default=None)
+    sp.add_argument("--engines", default=None, metavar="CONF_JSON",
+                    help="multi-tenant deploy: JSON file of tenant "
+                         "specs (serving/registry.py) — one process "
+                         "hosts N engine instances with per-tenant "
+                         "batcher queues, HBM budgets, and per-access-"
+                         "key admission; omit for the legacy single-"
+                         "engine server")
     sp.add_argument("--ip", default="localhost")
     sp.add_argument("--port", type=int, default=8000)
     sp.add_argument("--feedback", action="store_true")

@@ -323,11 +323,54 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
                            f"{root.get('generations')}: a reload "
                            "barrier aborted partway; re-run POST "
                            "/reload (KNOWN_ISSUES #15)"))
+        elif root.get("tenantGenerationSkew"):
+            checks.append(("router", WARN,
+                           detail + " — PER-TENANT GENERATION SKEW "
+                           f"{root.get('tenantGenerationSkew')}: these "
+                           "tenants serve different model generations "
+                           "across the fleet; re-run POST /reload"))
         elif any(b.get("breaker") == "open" for b in backends):
             checks.append(("router", WARN,
                            detail + " — a backend breaker is open"))
         else:
             checks.append(("router", OK, detail))
+
+    # multi-tenant registry (serving/registry.py) ----------------------
+    tenants = root.get("tenants")
+    if isinstance(tenants, dict) and tenants:
+        over = root.get("oversubscribed") or []
+        for name in sorted(tenants):
+            t = tenants[name] or {}
+            detail = (f"gen {t.get('generation', '?')}, queue depth "
+                      f"{t.get('queueDepth', '?')}, model "
+                      f"{_fmt_bytes(float(t.get('modelBytes') or 0))}")
+            budget = t.get("budgetMb")
+            if budget is not None:
+                used_mb = float(t.get("modelBytes") or 0) / (1024 * 1024)
+                detail += (f" of {budget:g} MiB budget "
+                           f"(headroom {budget - used_mb:.1f} MiB)")
+            if t.get("overBudget"):
+                checks.append((f"tenant:{name}", WARN,
+                               detail + " — OVER BUDGET (soft cap; "
+                               "load-time array-bytes estimate — "
+                               "KNOWN_ISSUES #16)"))
+            else:
+                checks.append((f"tenant:{name}", OK, detail))
+        cap = root.get("hbmHardCapMb")
+        total_mb = float(root.get("modelBytesTotal") or 0) / (1024 * 1024)
+        if over:
+            checks.append(("tenants", WARN,
+                           f"OVERSUBSCRIBED: {len(over)} tenant(s) over "
+                           f"their HBM budget ({', '.join(over)}); "
+                           "shrink a model, raise the budget, or move "
+                           "a tenant to another replica "
+                           "(KNOWN_ISSUES #16)"))
+        else:
+            cap_txt = (f", hard cap {cap:g} MiB" if cap else "")
+            checks.append(("tenants", OK,
+                           f"{len(tenants)} tenant(s), "
+                           f"{total_mb:.1f} MiB total{cap_txt}, all "
+                           "within budget"))
 
     # circuit breakers -------------------------------------------------
     open_eps = [labels for labels, v in

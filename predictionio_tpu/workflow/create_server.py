@@ -38,6 +38,10 @@ from predictionio_tpu.data.event import (
     format_event_time, tree_has_non_finite, utcnow,
 )
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.serving import registry as registry_mod
+from predictionio_tpu.serving.registry import (
+    DEFAULT_TENANT, AdmissionError, ModelRegistry, ServableModel, TenantSpec,
+)
 from predictionio_tpu.workflow import json_extractor, model_io
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
@@ -132,6 +136,14 @@ class ServerConfig:
     #: user-row capacity headroom pre-padded at load for fold-in
     #: appends (0 = PIO_FOLDIN_HEADROOM or 1024)
     foldin_headroom: int = 0
+    #: multi-tenant deploy (serving/registry.py): the parsed
+    #: ``pio deploy --engines conf.json`` tenant specs. Empty () is the
+    #: legacy single-engine server — every endpoint stays wire-byte
+    #: identical (asserted by test). Non-empty hosts one ModelRegistry
+    #: of N generation-versioned servables with per-tenant batcher
+    #: queues, HBM budgets, and per-access-key admission; unset
+    #: per-tenant knobs inherit the deploy-wide values above.
+    tenants: Tuple[TenantSpec, ...] = ()
 
 
 def resolve_engine_instance(storage: Storage, config: ServerConfig):
@@ -176,6 +188,14 @@ def engine_params_from_instance(engine: Engine, instance) -> EngineParams:
     if algos:
         variant["algorithms"] = algos
     return engine.engine_params_from_json(variant)
+
+
+def _datasource_appname(engine_params) -> Optional[str]:
+    """Best-effort appName from the variant's datasource params — the
+    same field fold-in and eval use to find the engine's app."""
+    dsp = getattr(engine_params, "data_source_params", None)
+    app_name = getattr(dsp, "appName", None)
+    return str(app_name) if app_name else None
 
 
 def prepare_deploy(ctx, engine: Engine, engine_params: EngineParams,
@@ -227,6 +247,17 @@ class QueryAPI:
         self._stop_requested = threading.Event()
         self._draining = threading.Event()
         self._batcher = None
+        #: the model registry replaces the single model field: every
+        #: deploy — legacy included — publishes its servable(s) here.
+        #: A legacy deploy installs one servable under DEFAULT_TENANT
+        #: and keeps mirroring the flat attributes below for
+        #: compatibility; a --engines deploy hosts N of them with
+        #: per-tenant queues/budgets/admission.
+        self.registry = ModelRegistry()
+        #: per-access-key admission (multi-tenant only; None = legacy
+        #: open door, wire parity)
+        self._admission: Optional[registry_mod.AdmissionController] = None
+        self._m_tenant_requests = None
         # serving stats (CreateServer.scala:399-401)
         self.request_count = 0
         self.avg_serving_sec = 0.0
@@ -292,6 +323,17 @@ class QueryAPI:
 
     # ------------------------------------------------------------- loading
     def _load(self) -> None:
+        """Load (or hot-swap) every configured servable: the legacy
+        single-engine path when no tenants are configured, else one
+        registry install per tenant spec. POST /reload funnels here
+        for both shapes — a multi-tenant reload hot-swaps every
+        tenant, each against its own latest COMPLETED instance."""
+        if self.config.tenants:
+            self._load_tenants()
+        else:
+            self._load_single()
+
+    def _load_single(self) -> None:
         t_load = time.perf_counter()
         instance = resolve_engine_instance(self.storage, self.config)
         engine = self._engine_override or get_engine(
@@ -355,6 +397,20 @@ class QueryAPI:
             instance, algorithms, models, extra_specs=foldin_specs)
         batcher = self._make_batcher(algorithms, models, serving,
                                      buckets=serve_buckets)
+        servable = ServableModel(
+            name=DEFAULT_TENANT,
+            spec=TenantSpec(name=DEFAULT_TENANT,
+                            access_key=self.config.access_key),
+            instance=instance, engine=engine, engine_params=engine_params,
+            algorithms=list(algorithms), models=list(models),
+            serving=serving, batcher=batcher, aot_state=aot_state,
+            shard_state=shard_state, quant_state=quant_state,
+            model_bytes=registry_mod.model_hbm_bytes(models))
+        # the registry is the source of truth for every deploy shape;
+        # budget enforcement (env opt-in for legacy) runs here, BEFORE
+        # the attribute swap — a refused load keeps the previous
+        # generation serving
+        self.registry.install(servable)
         with self._lock:
             self.engine_instance = instance
             self.engine = engine
@@ -393,6 +449,186 @@ class QueryAPI:
                 "foldin", "fold-in requested but no model is fold-in-"
                 "shaped (user/item factor matrices + vocabs); worker "
                 "not started", level=journal.WARN)
+
+    # -------------------------------------------------- multi-tenant loading
+    def _tenant_config(self, spec: TenantSpec) -> ServerConfig:
+        """The effective ServerConfig for one tenant's load: the spec's
+        engine pin + its overrides over the deploy-wide defaults.
+        Fold-in is forced off under multi-tenancy (the worker is a
+        single-model speed layer; README documents the limitation)."""
+        return dataclasses.replace(
+            self.config,
+            engine_instance_id=spec.engine_instance_id,
+            engine_id=spec.engine_id,
+            engine_version=spec.engine_version,
+            engine_variant=spec.engine_variant,
+            engine_dir=spec.engine_dir or self.config.engine_dir,
+            access_key=spec.access_key,
+            batching=spec.batching or self.config.batching,
+            batch_max_size=(spec.batch_max_size
+                            or self.config.batch_max_size),
+            batch_max_delay_ms=(spec.batch_max_delay_ms
+                                if spec.batch_max_delay_ms is not None
+                                else self.config.batch_max_delay_ms),
+            batch_max_queue=(spec.batch_max_queue
+                             or self.config.batch_max_queue),
+            foldin="off",
+            tenants=())
+
+    def _build_servable(self, spec: TenantSpec, *,
+                        is_reload: bool) -> ServableModel:
+        """One tenant's load pipeline: resolve → engine → models →
+        prepare_deploy → prepare_serving → shared AOT prebuild → its
+        OWN batcher. The AOT bucket set comes from the deploy-wide
+        batch_max_size, so every tenant pads onto the same
+        (bucket × template × k) program set and the process-wide memo
+        keeps compile count flat as tenants multiply — tenants share
+        compiled code, never queue capacity."""
+        cfg = self._tenant_config(spec)
+        instance = resolve_engine_instance(self.storage, cfg)
+        engine = self._engine_override or get_engine(
+            instance.engine_factory, base_dir=cfg.engine_dir)
+        engine_params = engine_params_from_instance(engine, instance)
+        blob = self.storage.get_model_data_models().get(instance.id)
+        if blob is None:
+            raise ValueError(
+                f"No model data for EngineInstance {instance.id}")
+        models = model_io.deserialize_models(blob.models)
+        _, _, algorithms, serving = engine._instantiate(engine_params)
+        for a in algorithms:
+            a.bind_serving(self.ctx)
+        models = prepare_deploy(
+            self.ctx, engine, engine_params, instance.id, models,
+            algorithms=algorithms)
+        from predictionio_tpu.ops import quant as serve_quant
+        from predictionio_tpu.parallel import serve_dist
+        with serve_dist.deploy_scope(cfg.shard_serving,
+                                     reload=is_reload), \
+                serve_quant.deploy_scope(cfg.serve_quant,
+                                         reload=is_reload):
+            models = [a.prepare_serving(m)
+                      for a, m in zip(algorithms, models)]
+            quant_requested = serve_quant.serving_enabled()
+        shard_state = next(
+            (m.sharding.summary() for m in models
+             if getattr(m, "sharding", None) is not None), None)
+        serve_dist.record_state(shard_state)
+        quant_state = serve_quant.summarize_deploy(
+            models, requested=quant_requested)
+        serve_quant.record_state(quant_state)
+        aot_state, serve_buckets = self._prebuild_aot(
+            instance, algorithms, models)
+        batcher = self._make_batcher(algorithms, models, serving,
+                                     buckets=serve_buckets, cfg=cfg,
+                                     name=f"tenant-{spec.name}")
+        return ServableModel(
+            name=spec.name, spec=spec, instance=instance, engine=engine,
+            engine_params=engine_params, algorithms=list(algorithms),
+            models=list(models), serving=serving, batcher=batcher,
+            aot_state=aot_state, shard_state=shard_state,
+            quant_state=quant_state,
+            model_bytes=registry_mod.model_hbm_bytes(models))
+
+    def _load_tenants(self) -> None:
+        t_load = time.perf_counter()
+        is_reload = self.generation > 0
+        for spec in self.config.tenants:
+            servable = self._build_servable(spec, is_reload=is_reload)
+            # install enforces the HBM budgets: past the hard cap the
+            # load is refused (ValueError) and — on reload — the
+            # tenant's previous generation keeps serving
+            prior = self.registry.install(servable)
+            if prior is not None and prior.batcher is not None:
+                prior.batcher.close()
+            journal.emit(
+                "tenant",
+                (f"tenant '{spec.name}' generation "
+                 f"{servable.generation} live (instance "
+                 f"{servable.instance.id}, "
+                 f"{servable.model_bytes / (1024 * 1024):.1f} MiB)"),
+                level=journal.INFO, tenant=spec.name,
+                generation=servable.generation,
+                instanceId=servable.instance.id,
+                modelBytes=servable.model_bytes)
+        self._admission = self._build_admission()
+        # flat mirrors point at the first tenant so shared internals
+        # (storage probe, plugin REST, tests poking api.algorithms)
+        # keep working; the multi-tenant wire never reads them
+        first = self.registry.get(self.config.tenants[0].name)
+        with self._lock:
+            self.engine_instance = first.instance
+            self.engine = first.engine
+            self.engine_params = first.engine_params
+            self.algorithms = first.algorithms
+            self.models = first.models
+            self.serving = first.serving
+        if self._m_tenant_requests is None:
+            # registered lazily so a legacy deploy's /metrics carries
+            # no tenant family at all (wire parity)
+            self._m_tenant_requests = telemetry.registry().counter(
+                "pio_tenant_requests_total",
+                "Multi-tenant /queries.json requests by tenant and "
+                "outcome (ok / saturated / rate_limited / denied / "
+                "error)",
+                labelnames=("tenant", "outcome"))
+        telemetry.registry().register_collector(self.registry.collect)
+        self.time_to_ready_s = time.perf_counter() - t_load
+        self._m_time_to_ready.set(self.time_to_ready_s)
+        self.generation += 1
+        names = self.registry.names()
+        logger.info("multi-tenant deploy: %d tenant(s) %s live in %.2fs",
+                    len(names), names, self.time_to_ready_s)
+        journal.emit(
+            "lifecycle",
+            (f"generation {self.generation} live (multi-tenant "
+             f"{'reload hot-swap' if is_reload else 'initial deploy'}: "
+             f"{len(names)} tenant(s))"),
+            level=journal.INFO, generation=self.generation,
+            tenants=names, reload=bool(is_reload),
+            timeToReadyS=round(self.time_to_ready_s, 3))
+
+    def _build_admission(self) -> registry_mod.AdmissionController:
+        """The key→app→tenant resolution map: each tenant's configured
+        access key names an app (AccessKeys DAO) and every key of that
+        app routes to that tenant; a spec without a key falls back to
+        its datasource appName (Apps DAO). Two tenants may not resolve
+        to the same app — per-key routing would be ambiguous."""
+        keys_dao = self.storage.get_meta_data_access_keys()
+        apps_dao = self.storage.get_meta_data_apps()
+        tenant_by_appid: Dict[int, str] = {}
+        tenant_limits: Dict[str, Tuple[Optional[float],
+                                       Optional[float]]] = {}
+        for spec in self.config.tenants:
+            tenant_limits[spec.name] = (spec.rate, spec.burst)
+            appid = None
+            if spec.access_key:
+                row = keys_dao.get(spec.access_key)
+                if row is not None:
+                    appid = row.appid
+            if appid is None:
+                servable = self.registry.get(spec.name)
+                app_name = _datasource_appname(
+                    servable.engine_params if servable else None)
+                if app_name:
+                    app = apps_dao.get_by_name(app_name)
+                    if app is not None:
+                        appid = app.id
+            if appid is None:
+                journal.emit(
+                    "tenant",
+                    (f"tenant '{spec.name}' has no resolvable access "
+                     "key or datasource appName; no key routes to it "
+                     "until one is configured"),
+                    level=journal.WARN, tenant=spec.name)
+                continue
+            if appid in tenant_by_appid:
+                raise ValueError(
+                    f"tenants '{tenant_by_appid[appid]}' and "
+                    f"'{spec.name}' both resolve to app id {appid}; "
+                    "per-key routing needs one app per tenant")
+            tenant_by_appid[appid] = spec.name
+        return registry_mod.AdmissionController(
+            self.storage, tenant_by_appid, tenant_limits=tenant_limits)
 
     def _install_foldin(self, engine_params, models, prep) -> None:
         """Create (first load) or re-bind (reload) the fold-in worker
@@ -487,7 +723,9 @@ class QueryAPI:
         devicewatch.note_aot(state)
         return state, buckets
 
-    def _make_batcher(self, algorithms, models, serving, buckets=None):
+    def _make_batcher(self, algorithms, models, serving, buckets=None,
+                      cfg: Optional[ServerConfig] = None,
+                      name: Optional[str] = None):
         """Build the request micro-batcher for this deployment, or None.
 
         `batching: auto` (the default) engages only when some algorithm
@@ -495,11 +733,15 @@ class QueryAPI:
         from coalescing device work, so it keeps the inline path. The
         flush closes over THIS load's (algorithms, models, serving): a
         /reload swaps in a new batcher while in-flight batches finish
-        against the engine they were admitted under."""
+        against the engine they were admitted under. A tenant load
+        passes its effective ``cfg`` (per-tenant queue capacity — one
+        tenant's saturation 503s never consume another's slots) and a
+        ``name`` that keys its own metric series."""
         from predictionio_tpu.serving import MicroBatcher, batch_capable
         from predictionio_tpu.serving import protocol
+        cfg = cfg or self.config
 
-        mode = (self.config.batching or "auto").lower()
+        mode = (cfg.batching or "auto").lower()
         if mode not in ("auto", "on", "off"):
             raise ValueError(
                 f"ServerConfig.batching must be auto/on/off, got {mode!r}")
@@ -535,12 +777,15 @@ class QueryAPI:
                 self._m_degraded_batches.inc()
             return [(p, degraded) for p in served]
 
+        kwargs: Dict[str, Any] = {}
+        if name is not None:
+            kwargs["name"] = name
         return MicroBatcher(
             flush,
-            max_batch_size=self.config.batch_max_size,
-            max_delay_ms=self.config.batch_max_delay_ms,
-            max_queue=self.config.batch_max_queue,
-            buckets=buckets)
+            max_batch_size=cfg.batch_max_size,
+            max_delay_ms=cfg.batch_max_delay_ms,
+            max_queue=cfg.batch_max_queue,
+            buckets=buckets, **kwargs)
 
     @property
     def stop_requested(self) -> bool:
@@ -580,9 +825,10 @@ class QueryAPI:
             worker.stop()
         with self._lock:
             batcher = self._batcher
-        if batcher is not None:
-            batcher.close(timeout=grace_s if grace_s is not None
-                          else self.config.drain_grace_s)
+        timeout = (grace_s if grace_s is not None
+                   else self.config.drain_grace_s)
+        for b in self._all_batchers(extra=batcher):
+            b.close(timeout=timeout)
         self._stop_requested.set()
         logger.info("drain: complete")
         journal.emit("lifecycle", "drain complete: every admitted "
@@ -598,8 +844,20 @@ class QueryAPI:
             worker.stop()
         with self._lock:
             batcher, self._batcher = self._batcher, None
-        if batcher is not None:
-            batcher.close()
+        for b in self._all_batchers(extra=batcher):
+            b.close()
+
+    def _all_batchers(self, extra=None):
+        """Every live batcher, deduped: the registry's per-tenant ones
+        plus the legacy flat mirror (the same object as the default
+        servable's in a legacy deploy)."""
+        seen: Dict[int, Any] = {}
+        for s in self.registry.servables():
+            if s.batcher is not None:
+                seen[id(s.batcher)] = s.batcher
+        if extra is not None:
+            seen[id(extra)] = extra
+        return list(seen.values())
 
     # ------------------------------------------------------------ dispatch
     def handle(self, method: str, path: str,
@@ -623,7 +881,7 @@ class QueryAPI:
             if t is not None:    # /metrics, /traces.json, /debug/device.json
                 return t
             if path == "/queries.json" and method == "POST":
-                return self._queries(body)
+                return self._queries(body, query)
             if path == "/reload" and method == "POST":
                 threading.Thread(target=self._reload, daemon=True).start()
                 return 200, {"message": "Reloading..."}
@@ -640,7 +898,13 @@ class QueryAPI:
                              method, path)
             return 500, {"message": str(e)}
 
+    @property
+    def _multitenant(self) -> bool:
+        return bool(self.config.tenants)
+
     def _status(self) -> Dict[str, Any]:
+        if self._multitenant:
+            return self._status_mt()
         i = self.engine_instance
         out = {
             "status": "alive",
@@ -687,7 +951,32 @@ class QueryAPI:
             out["foldin"] = worker.state()
         return out
 
+    def _status_mt(self) -> Dict[str, Any]:
+        """The multi-tenant `GET /` shape: per-tenant state blocks and
+        the generations dict the router's tenant skew check and the
+        doctor's per-tenant lines read. The process-wide `generation`
+        int stays (bumped once per _load call) so the PR 15 reload
+        barrier's integer compare keeps working unchanged."""
+        servables = self.registry.servables()
+        return {
+            "status": "alive",
+            "tenants": {s.name: s.state() for s in servables},
+            "generations": {s.name: s.generation for s in servables},
+            "generation": self.generation,
+            "requestCount": self.request_count,
+            "avgServingSec": self.avg_serving_sec,
+            "lastServingSec": self.last_serving_sec,
+            "degradedCount": self.degraded_count,
+            "draining": self._draining.is_set(),
+            "serverStartTime": format_event_time(self.start_time),
+            "modelBytesTotal": self.registry.total_model_bytes(),
+            "hbmHardCapMb": self.registry.hard_cap_mb,
+            "oversubscribed": self.registry.oversubscribed(),
+        }
+
     def _readyz(self) -> Response:
+        if self._multitenant:
+            return self._readyz_mt()
         """Readiness: a model is deployed, the admission queue has room,
         and the engine's storage answers a trivial probe. 503 while
         draining so load balancers stop routing here before shutdown."""
@@ -728,6 +1017,55 @@ class QueryAPI:
         return status, {"status": "ready" if ready else "unready",
                         "generation": self.generation, **checks}
 
+    def _readyz_mt(self) -> Response:
+        """Multi-tenant readiness: every configured tenant is loaded
+        and has queue room, storage answers. Carries both the
+        process-wide generation int (the router barrier's compare) and
+        the per-tenant generations dict (the per-tenant skew WARN)."""
+        gens = self.registry.generations()
+        if self._draining.is_set():
+            return 503, {"status": "draining",
+                         "generation": self.generation,
+                         "generations": gens}
+        checks: Dict[str, Any] = {}
+        ready = True
+        servables = self.registry.servables()
+        checks["modelLoaded"] = len(servables) == len(self.config.tenants)
+        ready &= checks["modelLoaded"]
+        depths: Dict[str, int] = {}
+        for s in servables:
+            if s.batcher is None:
+                continue
+            depth = s.batcher.depth()
+            depths[s.name] = depth
+            cap = (s.spec.batch_max_queue
+                   or self.config.batch_max_queue)
+            # one saturated tenant queue makes the REPLICA not ready
+            # for more traffic of that tenant; per-tenant shedding is
+            # the router's job — readiness only flips when every
+            # tenant is saturated (otherwise a single noisy neighbor
+            # would eject the replica for everyone)
+            if depth >= cap:
+                checks.setdefault("saturatedTenants", []).append(s.name)
+        if depths:
+            checks["queueDepths"] = depths
+        sat = checks.get("saturatedTenants")
+        if sat and len(sat) == len(depths):
+            ready = False
+        try:
+            instance = getattr(self, "engine_instance", None)
+            if instance is not None:
+                self.storage.get_meta_data_engine_instances().get(
+                    instance.id)
+            checks["storage"] = "ok"
+        except Exception as e:
+            checks["storage"] = f"{type(e).__name__}: {e}"
+            ready = False
+        status = 200 if ready else 503
+        return status, {"status": "ready" if ready else "unready",
+                        "generation": self.generation,
+                        "generations": gens, **checks}
+
     def _reload(self) -> None:
         try:
             self._load()
@@ -741,7 +1079,13 @@ class QueryAPI:
                 error=f"{type(e).__name__}: {e}")
 
     # ---------------------------------------------------------- query path
-    def _queries(self, body: bytes) -> Response:
+    def _tenant_outcome(self, tenant: str, outcome: str) -> None:
+        if self._m_tenant_requests is not None and telemetry.on():
+            self._m_tenant_requests.labels(
+                tenant=tenant, outcome=outcome).inc()
+
+    def _queries(self, body: bytes,
+                 url_query: Optional[Dict[str, str]] = None) -> Response:
         from predictionio_tpu.serving import ServerSaturated
         t0 = time.perf_counter()
         query_time = utcnow()
@@ -750,10 +1094,39 @@ class QueryAPI:
             # are steered to another replica
             return 503, {"message": "server is draining"}, \
                 {"Retry-After": "1"}
-        with self._lock:
+        tenant: Optional[str] = None
+        if self._multitenant:
+            # per-access-key admission (serving/registry.py): key →
+            # app → tenant against the AccessKeys DAO, then the key's
+            # token bucket. 401 unknown key, 429 + Retry-After past
+            # the rate limit — resolved ONCE here; every label below
+            # inherits the verdict.
+            try:
+                tenant = self._admission.admit(
+                    (url_query or {}).get("accessKey"))
+            except AdmissionError as e:
+                self._tenant_outcome(
+                    "-", "denied" if e.status == 401 else "rate_limited")
+                if e.retry_after_s is not None:
+                    return e.status, {"message": e.message}, \
+                        {"Retry-After": str(e.retry_after_s)}
+                return e.status, {"message": e.message}
+            servable = self.registry.get(tenant)
+            if servable is None:
+                self._tenant_outcome(tenant, "error")
+                return 503, {"message":
+                             f"tenant '{tenant}' is not loaded"}, \
+                    {"Retry-After": "1"}
             algorithms, models, serving, batcher = (
-                self.algorithms, self.models, self.serving, self._batcher)
-            instance = self.engine_instance
+                servable.algorithms, servable.models, servable.serving,
+                servable.batcher)
+            instance = servable.instance
+        else:
+            with self._lock:
+                algorithms, models, serving, batcher = (
+                    self.algorithms, self.models, self.serving,
+                    self._batcher)
+                instance = self.engine_instance
         try:
             query = json_extractor.extract_query(
                 getattr(algorithms[0], "query_class", None), body)
@@ -764,13 +1137,21 @@ class QueryAPI:
         # and every waterfall call below is a cheap no-op
         rec = waterfall.begin("batched" if batcher is not None
                               else "inline")
+        if rec is not None and tenant is not None:
+            # Dapper pattern: the request's tenant rides the waterfall
+            # record so slow-trace triage attributes per tenant
+            rec.note("tenant", tenant)
         if batcher is not None:
             # micro-batched path: block until this query's coalesced batch
-            # is served; concurrent requests share one device dispatch
+            # is served; concurrent requests share one device dispatch.
+            # Under multi-tenancy this is the TENANT'S batcher: its
+            # saturation 503s come out of its own queue only.
             try:
                 with waterfall.activate((rec,)):
                     prediction, degraded = batcher.submit(query)
             except ServerSaturated as e:
+                if tenant is not None:
+                    self._tenant_outcome(tenant, "saturated")
                 return 503, {"message": (
                     "serving queue is saturated (admission control); "
                     "retry later")}, {"Retry-After": str(e.retry_after_s)}
@@ -833,6 +1214,8 @@ class QueryAPI:
             # float walk, not a second serialization, on the latency path.
             logger.error("prediction for instance %s contains non-finite "
                          "scores; refusing to serve it", instance.id)
+            if tenant is not None:
+                self._tenant_outcome(tenant, "error")
             return 500, {"message":
                          "prediction contains non-finite scores (the "
                          "deployed model is numerically invalid); retrain "
@@ -847,8 +1230,9 @@ class QueryAPI:
             telemetry.registry().histogram(
                 "pio_serve_seconds",
                 "POST /queries.json end-to-end serve latency",
-                labelnames=("mode",)).labels(
-                    mode="batched" if batcher is not None else "inline"
+                labelnames=("mode", "tenant")).labels(
+                    mode="batched" if batcher is not None else "inline",
+                    tenant=tenant or DEFAULT_TENANT,
             ).observe(dt)
         with self._lock:  # ThreadingHTTPServer: concurrent queries
             self.last_serving_sec = dt
@@ -856,6 +1240,11 @@ class QueryAPI:
                 (self.avg_serving_sec * self.request_count) + dt
             ) / (self.request_count + 1)
             self.request_count += 1
+        if tenant is not None:
+            self._tenant_outcome(tenant, "ok")
+            # the router learns key→tenant from this header and labels
+            # its own counters without a second resolution
+            return 200, result, {"X-PIO-Tenant": tenant}
         return 200, result
 
     def _feedback(self, instance, query, prediction, result,

@@ -56,6 +56,7 @@ import logging
 import os
 import threading
 import time
+import urllib.parse
 from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.common import journal, resilience, telemetry, tracing
@@ -104,6 +105,12 @@ class RouterConfig:
     #: admission ceiling: concurrent in-flight forwards beyond this shed
     #: with 503 + Retry-After instead of queueing
     max_inflight: int = 0
+    #: per-tenant admission ceiling (multi-tenant backends): concurrent
+    #: in-flight forwards carrying one tenant's access key beyond this
+    #: shed with a tenant-labeled 503 — one tenant's flood never fills
+    #: the shared inflight pool. 0 (the default) disables the cap:
+    #: single-tenant fleets keep the PR 15 behavior byte for byte.
+    tenant_max_inflight: int = 0
 
     def resolved(self) -> "RouterConfig":
         return dataclasses.replace(
@@ -112,7 +119,10 @@ class RouterConfig:
             deadline_ms=(self.deadline_ms
                          or _env_pos("PIO_ROUTER_DEADLINE_MS", 2000.0)),
             max_inflight=(self.max_inflight
-                          or _env_int("PIO_ROUTER_MAX_INFLIGHT", 256)))
+                          or _env_int("PIO_ROUTER_MAX_INFLIGHT", 256)),
+            tenant_max_inflight=(
+                self.tenant_max_inflight
+                or _env_int("PIO_ROUTER_TENANT_MAX_INFLIGHT", 0)))
 
 
 def _parse_backend(url: str) -> Tuple[str, int]:
@@ -148,6 +158,9 @@ class _Backend:
         self.healthy = False
         self.admitted = True
         self.generation: Optional[int] = None
+        #: per-tenant generation ids (multi-tenant backends report a
+        #: dict on /readyz; None for a legacy single-engine replica)
+        self.tenant_generations: Optional[Dict[str, int]] = None
         self.draining = False
         #: always-on breaker (unlike the remote driver's opt-in
         #: registry): a fleet front door without one queues on corpses.
@@ -205,13 +218,17 @@ class _Backend:
             raise
 
     def probe(self, timeout: float = 2.0
-              ) -> Tuple[bool, bool, Optional[int]]:
-        """(healthy, draining, generation) from one /readyz read over a
-        FRESH connection — a pooled keep-alive socket can outlive the
-        listener it connected to, and membership must answer "can a new
-        request reach this replica", not "does an old socket still
-        drain". A 503 body still carries ``status``/``generation`` — a
-        draining replica is distinguishable from a dead one."""
+              ) -> Tuple[bool, bool, Optional[int],
+                         Optional[Dict[str, int]]]:
+        """(healthy, draining, generation, tenant_generations) from one
+        /readyz read over a FRESH connection — a pooled keep-alive
+        socket can outlive the listener it connected to, and membership
+        must answer "can a new request reach this replica", not "does
+        an old socket still drain". A 503 body still carries
+        ``status``/``generation`` — a draining replica is
+        distinguishable from a dead one. Multi-tenant replicas also
+        report a per-tenant ``generations`` dict; a legacy replica's
+        body has no such key and the 4th element stays None."""
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout)
         try:
@@ -219,23 +236,28 @@ class _Backend:
             resp = conn.getresponse()
             status, payload = resp.status, resp.read()
         except _TRANSPORT_ERRORS:
-            return False, False, None
+            return False, False, None, None
         finally:
             try:
                 conn.close()
             except Exception:
                 pass
         gen: Optional[int] = None
+        tenant_gens: Optional[Dict[str, int]] = None
         draining = False
         try:
             obj = json.loads(payload)
             if isinstance(obj, dict):
                 if obj.get("generation") is not None:
                     gen = int(obj["generation"])
+                raw = obj.get("generations")
+                if isinstance(raw, dict):
+                    tenant_gens = {str(k): int(v)
+                                   for k, v in raw.items()}
                 draining = obj.get("status") == "draining"
         except (ValueError, TypeError):
             pass
-        return status == 200, draining, gen
+        return status == 200, draining, gen, tenant_gens
 
     def close(self) -> None:
         with self._idle_lock:
@@ -247,7 +269,7 @@ class _Backend:
                 pass
 
     def state(self) -> Dict[str, Any]:
-        return {
+        out = {
             "url": self.url,
             "healthy": self.healthy,
             "inRotation": self.healthy and self.admitted,
@@ -255,6 +277,11 @@ class _Backend:
             "generation": self.generation,
             "breaker": self.breaker.state,
         }
+        if self.tenant_generations is not None:
+            # only for multi-tenant replicas: a legacy fleet's status
+            # payload keeps the exact PR 15 key set (wire parity)
+            out["generations"] = dict(self.tenant_generations)
+        return out
 
 
 class RouterAPI:
@@ -282,6 +309,15 @@ class RouterAPI:
         self._draining = threading.Event()
         self._reload_lock = threading.Lock()
         self._reload_state: Dict[str, Any] = {"active": False}
+        #: tenant-aware front door: access key -> tenant name, learned
+        #: from backend X-PIO-Tenant response headers (the backend's
+        #: AccessKeys-DAO resolution — the router never opens a storage
+        #: connection of its own); and the per-tenant in-flight counts
+        #: the tenant_max_inflight cap charges. Keys that have not
+        #: answered yet are charged under the key itself, so the cap
+        #: binds from the very first request.
+        self._tenant_by_key: Dict[str, str] = {}
+        self._tenant_inflight: Dict[str, int] = {}
         self.start_time = time.perf_counter()
         self.request_count = 0
         self.shed_count = 0
@@ -294,7 +330,8 @@ class RouterAPI:
         self._m_requests = reg.counter(
             "pio_router_requests_total",
             "Routed /queries.json requests by outcome (ok / failover_ok "
-            "/ shed / deadline / error)", labelnames=("outcome",))
+            "/ shed / deadline / error) and tenant ('-' when the query "
+            "carries no access key)", labelnames=("outcome", "tenant"))
         self._m_failovers = reg.counter(
             "pio_router_failovers_total",
             "Forwards retried on another replica after a transport "
@@ -321,13 +358,15 @@ class RouterAPI:
     # ----------------------------------------------------------- membership
     def _poll_once(self, timeout: float = 2.0) -> None:
         for b in self.backends:
-            healthy, draining, gen = b.probe(timeout=timeout)
+            healthy, draining, gen, tenant_gens = b.probe(timeout=timeout)
             with self._lock:
                 was = b.healthy
                 b.healthy = healthy
                 b.draining = draining
                 if gen is not None:
                     b.generation = gen
+                if tenant_gens is not None:
+                    b.tenant_generations = tenant_gens
             if healthy and not was:
                 journal.emit(
                     "router", f"backend {b.name} re-admitted "
@@ -413,7 +452,7 @@ class RouterAPI:
             if t is not None:
                 return t
             if path == "/queries.json" and method == "POST":
-                return self._queries(body, headers or {})
+                return self._queries(body, headers or {}, query or {})
             if path == "/reload" and method == "POST":
                 return self._start_reload(query or {})
             if path == "/stop" and method == "POST":
@@ -429,7 +468,7 @@ class RouterAPI:
             backends = [b.state() for b in self.backends]
         gens = {b["generation"] for b in backends
                 if b["generation"] is not None}
-        return {
+        out = {
             "status": "alive",
             "router": True,
             "backends": backends,
@@ -442,6 +481,21 @@ class RouterAPI:
             "reload": dict(self._reload_state),
             "draining": self._draining.is_set(),
         }
+        # per-tenant skew over multi-tenant backends only: a legacy
+        # fleet's payload keeps the exact PR 15 key set (wire parity).
+        # tenantGenerations maps tenant -> sorted distinct generations
+        # seen across the fleet; a list longer than 1 is skew for THAT
+        # tenant (the doctor WARN names it).
+        tenant_gens: Dict[str, set] = {}
+        for b in backends:
+            for name, g in (b.get("generations") or {}).items():
+                tenant_gens.setdefault(name, set()).add(g)
+        if tenant_gens:
+            out["tenantGenerations"] = {
+                n: sorted(v) for n, v in sorted(tenant_gens.items())}
+            out["tenantGenerationSkew"] = sorted(
+                n for n, v in tenant_gens.items() if len(v) > 1)
+        return out
 
     def _readyz(self) -> Response:
         """Ready while at least one backend is in rotation — the router's
@@ -476,33 +530,82 @@ class RouterAPI:
                 pass
         return budget
 
-    def _queries(self, body: bytes, headers: Dict[str, str]) -> Response:
+    def _tenant_label(self, key: Optional[str]) -> str:
+        """The metric/shed label for a query's tenant: the learned name
+        when a backend has answered for this key, the key itself before
+        that, '-' for a key-less (legacy) query."""
+        if not key:
+            return "-"
+        with self._lock:
+            return self._tenant_by_key.get(key, key)
+
+    def _queries(self, body: bytes, headers: Dict[str, str],
+                 query: Optional[Dict[str, str]] = None) -> Response:
         t_start = time.perf_counter()
         if self._draining.is_set():
             return 503, {"message": "router is draining"}, \
                 {"Retry-After": "1"}
-        if not self._inflight.acquire(blocking=False):
-            # admission control: the fleet is saturated end to end;
-            # queueing here would only grow latency without bound
-            self._shed("inflight")
-            return 503, {"message": (
-                "router is saturated (admission control); retry later")}, \
-                {"Retry-After": "1"}
+        key = (query or {}).get("accessKey")
+        tenant = self._tenant_label(key)
+        cap = self.config.tenant_max_inflight
+        charged = False
+        if key and cap > 0:
+            # per-tenant shedding at the front door: one tenant's flood
+            # sheds ITS queries before it can fill the shared pool
+            with self._lock:
+                count = self._tenant_inflight.get(tenant, 0)
+                if count >= cap:
+                    over = True
+                else:
+                    self._tenant_inflight[tenant] = count + 1
+                    over = False
+            if over:
+                self._shed("tenant-inflight", tenant=tenant)
+                return 503, {"message": (
+                    f"tenant '{tenant}' is saturated at the router "
+                    "(per-tenant admission control); retry later")}, \
+                    {"Retry-After": "1"}
+            charged = True
         try:
-            return self._forward(body, headers, t_start)
+            if not self._inflight.acquire(blocking=False):
+                # admission control: the fleet is saturated end to end;
+                # queueing here would only grow latency without bound
+                self._shed("inflight", tenant=tenant)
+                return 503, {"message": (
+                    "router is saturated (admission control); "
+                    "retry later")}, \
+                    {"Retry-After": "1"}
+            try:
+                return self._forward(body, headers, t_start, key=key)
+            finally:
+                self._inflight.release()
         finally:
-            self._inflight.release()
+            if charged:
+                with self._lock:
+                    n = self._tenant_inflight.get(tenant, 1) - 1
+                    if n <= 0:
+                        self._tenant_inflight.pop(tenant, None)
+                    else:
+                        self._tenant_inflight[tenant] = n
 
-    def _shed(self, reason: str) -> None:
+    def _shed(self, reason: str, tenant: str = "-") -> None:
         with self._lock:
             self.shed_count += 1
         if telemetry.on():
-            self._m_requests.labels(outcome="shed").inc()
+            self._m_requests.labels(outcome="shed", tenant=tenant).inc()
         logger.warning("router shed a query (%s)", reason)
 
     def _forward(self, body: bytes, headers: Dict[str, str],
-                 t_start: float) -> Response:
+                 t_start: float, key: Optional[str] = None) -> Response:
         deadline = t_start + self._budget_s(headers)
+        # tenant-aware routing: the query's access key rides the
+        # forwarded URL so the backend's admission control resolves the
+        # SAME key the client presented (key-less legacy queries keep
+        # the bare path, byte for byte)
+        fwd_path = "/queries.json"
+        if key:
+            fwd_path += "?" + urllib.parse.urlencode({"accessKey": key})
+        tenant = self._tenant_label(key)
         fwd_headers = {"Content-Type": "application/json"}
         ctx = tracing.current()
         if ctx is not None:
@@ -517,14 +620,15 @@ class RouterAPI:
         while True:
             b = self._pick(exclude)
             if b is None:
-                self._shed("no backend in rotation")
+                self._shed("no backend in rotation", tenant=tenant)
                 return 503, {"message": (
                     "no healthy backend in rotation; retry later")}, \
                     {"Retry-After": "1"}
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
                 if telemetry.on():
-                    self._m_requests.labels(outcome="deadline").inc()
+                    self._m_requests.labels(outcome="deadline",
+                                            tenant=tenant).inc()
                 return 504, {"message": "deadline exceeded"}
             # while a failover retry is still possible, reserve half the
             # remaining budget for it: a replica slower than half the
@@ -545,11 +649,11 @@ class RouterAPI:
                 if ctx is not None:
                     with tracing.span("route", service=b.name):
                         status, payload, rheaders = b.request(
-                            "POST", "/queries.json", body, hdrs,
+                            "POST", fwd_path, body, hdrs,
                             timeout=attempt_timeout)
                 else:
                     status, payload, rheaders = b.request(
-                        "POST", "/queries.json", body, hdrs,
+                        "POST", fwd_path, body, hdrs,
                         timeout=attempt_timeout)
             except _TRANSPORT_ERRORS as e:
                 backend_s += time.perf_counter() - t0
@@ -568,7 +672,8 @@ class RouterAPI:
                         self._m_failovers.inc()
                     continue
                 if telemetry.on():
-                    self._m_requests.labels(outcome="error").inc()
+                    self._m_requests.labels(outcome="error",
+                                            tenant=tenant).inc()
                 return 502, {"message": (
                     f"backend {b.name} failed ({type(e).__name__}) and "
                     "the failover budget is spent")}
@@ -588,16 +693,26 @@ class RouterAPI:
                     self._m_failovers.inc()
                 continue
             return self._respond(status, payload, rheaders, failed_over,
-                                 t_start, backend_s)
+                                 t_start, backend_s, key=key)
 
     def _respond(self, status: int, payload: bytes,
                  rheaders: Dict[str, str], failed_over: bool,
-                 t_start: float, backend_s: float) -> Response:
+                 t_start: float, backend_s: float,
+                 key: Optional[str] = None) -> Response:
+        # learn key→tenant from the backend's resolution (X-PIO-Tenant
+        # rides every successful multi-tenant answer) so per-tenant
+        # labels and the inflight cap use real names from here on
+        learned = rheaders.get("x-pio-tenant")
+        if key and learned:
+            with self._lock:
+                self._tenant_by_key[key] = learned
+        tenant = learned or self._tenant_label(key)
         try:
             obj = json.loads(payload) if payload else {}
         except ValueError:
             if telemetry.on():
-                self._m_requests.labels(outcome="error").inc()
+                self._m_requests.labels(outcome="error",
+                                        tenant=tenant).inc()
             return 502, {"message": "backend returned a non-JSON reply"}
         extra: Dict[str, str] = {}
         if rheaders.get("retry-after"):
@@ -607,7 +722,7 @@ class RouterAPI:
         if telemetry.on():
             outcome = ("error" if status >= 500
                        else "failover_ok" if failed_over else "ok")
-            self._m_requests.labels(outcome=outcome).inc()
+            self._m_requests.labels(outcome=outcome, tenant=tenant).inc()
             # added latency = our handler time minus the backend call —
             # both clocks end host-side in this pure-Python path
             self._m_overhead.observe(
@@ -646,14 +761,31 @@ class RouterAPI:
         """Poll one backend until its generation moves past ``old_gen``
         AND it is ready again."""
         deadline = time.perf_counter() + timeout_s
+        old_tenant_gens = dict(b.tenant_generations or {})
         while time.perf_counter() < deadline:
-            healthy, _draining, gen = b.probe()
+            healthy, _draining, gen, tenant_gens = b.probe()
             with self._lock:
                 if gen is not None:
                     b.generation = gen
+                if tenant_gens is not None:
+                    b.tenant_generations = tenant_gens
                 b.healthy = healthy
             if healthy and gen is not None and (
                     old_gen is None or gen > old_gen):
+                # a multi-tenant replica's /reload hot-swaps every
+                # tenant; verify each advanced and journal the ones
+                # that did not (the per-tenant skew the doctor WARNs on)
+                if tenant_gens and old_tenant_gens:
+                    stale = sorted(
+                        n for n, g in old_tenant_gens.items()
+                        if tenant_gens.get(n, g + 1) <= g)
+                    if stale:
+                        journal.emit(
+                            "router",
+                            f"backend {b.name} flipped but tenant(s) "
+                            f"{stale} kept their old generation",
+                            level=journal.WARN, backend=b.name,
+                            tenants=stale)
                 return True
             time.sleep(min(self.config.health_ms / 1e3, 0.2))
         return False

@@ -1,0 +1,178 @@
+"""tools/apps.py lifecycle coverage (commands/App.scala +
+AccessKey.scala parity): app new/show/delete, channelNew/channelDelete
+including event-store cleanup, data-delete truncation, and the
+delete-with-live-keys ordering (channel stores torn down before keys
+and the meta row)."""
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.tools import apps
+from predictionio_tpu.tools.apps import CommandError
+
+
+def _ev(name="view", eid="u1"):
+    return Event(event=name, entity_type="user", entity_id=eid,
+                 properties=DataMap({}))
+
+
+class TestAppCreateShow:
+    def test_create_show_list(self, memory_storage):
+        desc = apps.create("Shop", description="store front",
+                           storage=memory_storage)
+        assert desc.app.name == "Shop" and desc.app.id > 0
+        assert len(desc.keys) == 1 and desc.keys[0].appid == desc.app.id
+        assert desc.keys[0].key            # generated, non-empty
+        # event store initialized: an insert works immediately
+        memory_storage.get_events().insert(_ev(), desc.app.id)
+
+        shown, channels = apps.show("Shop", storage=memory_storage)
+        assert shown.app.id == desc.app.id and channels == []
+
+        apps.create("Bazaar", storage=memory_storage)
+        listed = apps.list_apps(storage=memory_storage)
+        assert [d.app.name for d in listed] == ["Bazaar", "Shop"]
+
+    def test_create_duplicate_name_refused(self, memory_storage):
+        apps.create("Shop", storage=memory_storage)
+        with pytest.raises(CommandError, match="already exists"):
+            apps.create("Shop", storage=memory_storage)
+
+    def test_create_explicit_id(self, memory_storage):
+        desc = apps.create("Pinned", app_id=42, storage=memory_storage)
+        assert desc.app.id == 42
+        with pytest.raises(CommandError, match="already exists"):
+            apps.create("Other", app_id=42, storage=memory_storage)
+        with pytest.raises(CommandError, match="invalid"):
+            apps.create("Neg", app_id=-1, storage=memory_storage)
+
+    def test_create_custom_key(self, memory_storage):
+        desc = apps.create("Keyed", access_key="my-key",
+                           storage=memory_storage)
+        assert desc.keys[0].key == "my-key"
+        row = memory_storage.get_meta_data_access_keys().get("my-key")
+        assert row is not None and row.appid == desc.app.id
+
+    def test_show_missing(self, memory_storage):
+        with pytest.raises(CommandError, match="does not exist"):
+            apps.show("ghost", storage=memory_storage)
+
+
+class TestChannels:
+    def test_channel_new_show_delete(self, memory_storage):
+        desc = apps.create("Shop", storage=memory_storage)
+        ch = apps.channel_new("Shop", "mobile", storage=memory_storage)
+        assert ch.name == "mobile" and ch.appid == desc.app.id
+        # the channel's event store exists: channel-scoped insert works
+        memory_storage.get_events().insert(_ev(), desc.app.id, ch.id)
+        _, channels = apps.show("Shop", storage=memory_storage)
+        assert [c.name for c in channels] == ["mobile"]
+
+        apps.channel_delete("Shop", "mobile", storage=memory_storage)
+        _, channels = apps.show("Shop", storage=memory_storage)
+        assert channels == []
+
+    def test_channel_validation(self, memory_storage):
+        apps.create("Shop", storage=memory_storage)
+        apps.channel_new("Shop", "mobile", storage=memory_storage)
+        with pytest.raises(CommandError, match="already exists"):
+            apps.channel_new("Shop", "mobile", storage=memory_storage)
+        with pytest.raises(CommandError, match="invalid"):
+            apps.channel_new("Shop", "way_too_long_channel_name",
+                             storage=memory_storage)
+        with pytest.raises(CommandError, match="invalid"):
+            apps.channel_new("Shop", "bad_chars!", storage=memory_storage)
+        with pytest.raises(CommandError, match="does not exist"):
+            apps.channel_new("ghost", "mobile", storage=memory_storage)
+        with pytest.raises(CommandError, match="doesn't exist"):
+            apps.channel_delete("Shop", "desktop", storage=memory_storage)
+
+    def test_channel_new_rolls_back_on_store_failure(self, memory_storage,
+                                                     monkeypatch):
+        apps.create("Shop", storage=memory_storage)
+        monkeypatch.setattr(memory_storage.get_events(), "init",
+                            lambda app_id, channel_id=None: False)
+        with pytest.raises(CommandError, match="initialize Event Store"):
+            apps.channel_new("Shop", "mobile", storage=memory_storage)
+        # the half-made channel row was rolled back
+        _, channels = apps.show("Shop", storage=memory_storage)
+        assert channels == []
+
+
+class TestDelete:
+    def test_delete_with_live_keys_and_channels(self, memory_storage):
+        """The App.scala:128-193 ordering: channel event stores first,
+        then the app store, THEN keys, then the meta row — so a failed
+        event-store removal leaves the keys intact (the app is still
+        addressable for a retry)."""
+        desc = apps.create("Shop", storage=memory_storage)
+        apps.accesskey_new("Shop", key="extra-key", storage=memory_storage)
+        ch = apps.channel_new("Shop", "mobile", storage=memory_storage)
+        memory_storage.get_events().insert(_ev(), desc.app.id)
+        memory_storage.get_events().insert(_ev(), desc.app.id, ch.id)
+
+        apps.delete("Shop", storage=memory_storage)
+        keys = memory_storage.get_meta_data_access_keys()
+        assert memory_storage.get_meta_data_apps().get_by_name("Shop") is None
+        assert keys.get("extra-key") is None      # both keys cleaned up
+        assert keys.get_by_appid(desc.app.id) == []
+        assert memory_storage.get_meta_data_channels().get_by_appid(
+            desc.app.id) == []
+        with pytest.raises(CommandError, match="does not exist"):
+            apps.delete("Shop", storage=memory_storage)
+
+    def test_delete_keeps_keys_when_store_removal_fails(self, memory_storage,
+                                                        monkeypatch):
+        desc = apps.create("Shop", access_key="live-key",
+                           storage=memory_storage)
+        monkeypatch.setattr(memory_storage.get_events(), "remove",
+                            lambda app_id, channel_id=None: False)
+        with pytest.raises(CommandError, match="Error removing Event Store"):
+            apps.delete("Shop", storage=memory_storage)
+        # ordering contract: nothing after the failed store removal ran
+        keys = memory_storage.get_meta_data_access_keys()
+        assert keys.get("live-key") is not None
+        assert memory_storage.get_meta_data_apps().get(desc.app.id) is not None
+
+    def test_data_delete_truncates(self, memory_storage):
+        desc = apps.create("Shop", storage=memory_storage)
+        ch = apps.channel_new("Shop", "mobile", storage=memory_storage)
+        events = memory_storage.get_events()
+        events.insert(_ev(), desc.app.id)
+        events.insert(_ev(), desc.app.id, ch.id)
+
+        apps.data_delete("Shop", storage=memory_storage)
+        assert list(events.find(app_id=desc.app.id)) == []
+        # channel data untouched without --all
+        assert len(list(events.find(app_id=desc.app.id,
+                                    channel_id=ch.id))) == 1
+
+        events.insert(_ev(), desc.app.id)
+        apps.data_delete("Shop", delete_all=True, storage=memory_storage)
+        assert list(events.find(app_id=desc.app.id)) == []
+        assert list(events.find(app_id=desc.app.id, channel_id=ch.id)) == []
+
+        apps.data_delete("Shop", channel="mobile", storage=memory_storage)
+        with pytest.raises(CommandError, match="doesn't exist"):
+            apps.data_delete("Shop", channel="desktop",
+                             storage=memory_storage)
+
+
+class TestAccessKeys:
+    def test_key_lifecycle(self, memory_storage):
+        apps.create("Shop", access_key="k0", storage=memory_storage)
+        k = apps.accesskey_new("Shop", key="k1", events=("view", "buy"),
+                               storage=memory_storage)
+        assert k.key == "k1" and k.events == ("view", "buy")
+        keys = apps.accesskey_list("Shop", storage=memory_storage)
+        assert {x.key for x in keys} == {"k0", "k1"}
+        assert len(apps.accesskey_list(storage=memory_storage)) == 2
+
+        apps.accesskey_delete("k1", storage=memory_storage)
+        with pytest.raises(CommandError, match="does not exist"):
+            apps.accesskey_delete("k1", storage=memory_storage)
+        with pytest.raises(CommandError, match="does not exist"):
+            apps.accesskey_new("ghost", storage=memory_storage)
+        with pytest.raises(CommandError, match="does not exist"):
+            apps.accesskey_list("ghost", storage=memory_storage)

@@ -284,6 +284,36 @@ def test_declarations_pass_accepts_declared_journal_category():
                 if f.rule == "journal-undeclared"]
 
 
+def test_declarations_pass_fires_on_undeclared_tenant_metric():
+    """The multi-tenant subsystem is inside the declarations triangle:
+    a tenant-labeled family NOT in METRICS fails the pass, while the
+    registered pio_tenant_* families, the PIO_TENANT_* env knobs, and
+    the 'tenant' journal category all pass."""
+    src = ("from predictionio_tpu.common import telemetry\n"
+           "c = telemetry.registry().counter(\n"
+           "    'pio_tenant_evictions_total', 'x',\n"
+           "    labelnames=('tenant',))\n")
+    found = [f for f in declarations.run(
+        [_mod(src, rel="predictionio_tpu/serving/registry.py")],
+        readme_text="") if f.rule == "metric-undeclared"]
+    assert len(found) == 1
+    assert "pio_tenant_evictions_total" in found[0].message
+
+    ok = ("import os\n"
+          "from predictionio_tpu.common import journal, telemetry\n"
+          "r = os.environ.get('PIO_TENANT_RATE', '')\n"
+          "h = os.environ.get('PIO_TENANT_HBM_HARD_CAP_MB', '')\n"
+          "c = telemetry.registry().counter(\n"
+          "    'pio_tenant_requests_total', 'x',\n"
+          "    labelnames=('tenant', 'outcome'))\n"
+          "journal.emit('tenant', 'over budget', level=journal.WARN)\n")
+    found = declarations.run(
+        [_mod(ok, rel="predictionio_tpu/serving/registry.py")],
+        readme_text="")
+    assert not [f for f in found if f.rule in (
+        "metric-undeclared", "env-undeclared", "journal-undeclared")]
+
+
 def test_declarations_pass_fires_on_undeclared_category_in_realtime():
     """The new realtime subsystem is inside the journal-undeclared
     scope like everything else: a fold-in emitter with a typo'd

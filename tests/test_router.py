@@ -209,6 +209,133 @@ def test_router_inflight_admission_bound(memory_storage):
 
 
 # ---------------------------------------------------------------------------
+# tenant-aware routing (PR 16): key forwarding, learned labels, per-tenant
+# shedding, and per-tenant generation skew
+# ---------------------------------------------------------------------------
+
+class _MTStubAPI:
+    """A minimal multi-tenant replica double: /readyz reports the
+    per-tenant ``generations`` dict, /queries.json records the
+    accessKey the router forwarded and answers with the backend's
+    X-PIO-Tenant resolution header — the two wire surfaces the
+    router's tenant awareness is built on."""
+
+    KEYMAP = {"shop-key": "shop", "news-key": "news"}
+
+    def __init__(self, generations):
+        self.generations = dict(generations)
+        self.seen_keys = []
+
+    def handle(self, method, path, query=None, body=b"", headers=None):
+        path = (path or "/").rstrip("/") or "/"
+        if method == "GET" and path in ("/", "/healthz", "/readyz"):
+            return 200, {"status": "ready",
+                         "generation": max(self.generations.values()),
+                         "generations": dict(self.generations)}
+        if method == "POST" and path == "/queries.json":
+            key = (query or {}).get("accessKey")
+            self.seen_keys.append(key)
+            if key is None:
+                return 200, {"legacy": True}
+            tenant = self.KEYMAP.get(key)
+            if tenant is None:
+                return 401, {"message": "Invalid accessKey."}
+            return 200, {"tenant": tenant}, {"X-PIO-Tenant": tenant}
+        return 404, {"message": "Not Found"}
+
+
+def _wait_rotation(router, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.handle("GET", "/")[1]["inRotation"] == n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"fleet never reached {n} backends in rotation")
+
+
+def _post_keyed(rport, key=None):
+    conn = http.client.HTTPConnection("127.0.0.1", rport)
+    try:
+        path = "/queries.json"
+        if key:
+            path += f"?accessKey={key}"
+        conn.request("POST", path, body=b'{"user": "u1", "num": 1}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), \
+            {k.lower(): v for k, v in resp.getheaders()}
+    finally:
+        conn.close()
+
+
+def test_router_tenant_forwarding_learning_and_skew():
+    """The access key rides the forwarded URL (the backend resolves
+    the SAME key the client presented), the router learns key->tenant
+    from X-PIO-Tenant, and per-tenant generation skew across the fleet
+    is surfaced by name — while key-less queries keep the bare
+    legacy path byte for byte."""
+    stub0 = _MTStubAPI({"shop": 3, "news": 4})
+    stub1 = _MTStubAPI({"shop": 3, "news": 5})   # news lags: skew
+    server0, port0 = serve_background(stub0)
+    server1, port1 = serve_background(stub1)
+    router, rserver, rport = _router([port0, port1])
+    try:
+        _wait_rotation(router, 2)
+        # keyed query: forwarded WITH the key, answered, learned
+        status, payload, _ = _post_keyed(rport, "shop-key")
+        assert status == 200 and payload["tenant"] == "shop"
+        assert (stub0.seen_keys + stub1.seen_keys) == ["shop-key"]
+        assert router._tenant_by_key == {"shop-key": "shop"}
+        # key-less query: bare legacy path, no tenant involvement
+        status, payload, _ = _post_keyed(rport)
+        assert status == 200 and payload == {"legacy": True}
+        assert None in (stub0.seen_keys + stub1.seen_keys)
+        # an unknown key's 401 passes through untouched
+        assert _post_keyed(rport, "wrong")[0] == 401
+        # fleet status: per-tenant generations + the skewed tenant named
+        st = router.handle("GET", "/")[1]
+        assert st["tenantGenerations"] == {"news": [4, 5], "shop": [3]}
+        assert st["tenantGenerationSkew"] == ["news"]
+    finally:
+        rserver.shutdown()
+        router.close()
+        server0.shutdown()
+        server1.shutdown()
+
+
+def test_router_tenant_inflight_cap_sheds_one_tenant_only():
+    """PIO_ROUTER_TENANT_MAX_INFLIGHT: a saturated tenant sheds 503 at
+    the front door while other tenants and key-less queries ride on —
+    and the cap charges the LEARNED tenant name, not the raw key."""
+    stub = _MTStubAPI({"shop": 1, "news": 1})
+    server, port = serve_background(stub)
+    router, rserver, rport = _router([port], tenant_max_inflight=1)
+    try:
+        _wait_rotation(router, 1)
+        # prime the learned mapping
+        assert _post_keyed(rport, "shop-key")[0] == 200
+        assert router._tenant_by_key["shop-key"] == "shop"
+        # saturate tenant shop from under the handler
+        with router._lock:
+            router._tenant_inflight["shop"] = 1
+        status, payload, headers = _post_keyed(rport, "shop-key")
+        assert status == 503
+        assert "tenant 'shop' is saturated" in payload["message"]
+        assert headers["retry-after"]
+        # ...while news and key-less traffic are untouched
+        assert _post_keyed(rport, "news-key")[0] == 200
+        assert _post_keyed(rport)[0] == 200
+        # releasing the slot re-admits shop (no sticky state)
+        with router._lock:
+            router._tenant_inflight.pop("shop", None)
+        assert _post_keyed(rport, "shop-key")[0] == 200
+    finally:
+        rserver.shutdown()
+        router.close()
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # failover + membership (tier-1 chaos smoke)
 # ---------------------------------------------------------------------------
 

@@ -680,7 +680,7 @@ def test_telemetry_on_records_serve_latency(memory_storage):
             {"user": "u1", "num": 2}).encode())
         assert st == 200
         fam = telemetry.registry().histogram(
-            "pio_serve_seconds", labelnames=("mode",))
-        assert fam.labels(mode="batched").count >= 1
+            "pio_serve_seconds", labelnames=("mode", "tenant"))
+        assert fam.labels(mode="batched", tenant="default").count >= 1
     finally:
         api.close()

@@ -1,0 +1,203 @@
+"""Production webhook connectors end-to-end (SegmentIOConnector.scala /
+MailChimpConnector.scala parity): every message type of both
+default-registered connectors converts over the fixture payloads, the
+EventAPI ingests them channel-scoped at the wire (201), and malformed
+payloads answer 400 — never 500."""
+
+import json
+import urllib.parse
+
+import pytest
+
+from predictionio_tpu.data.api import EventAPI, EventServerConfig
+from predictionio_tpu.data.storage import AccessKey, App, Channel
+from predictionio_tpu.data.webhooks import (
+    ConnectorException, default_form_connectors, default_json_connectors,
+    to_event,
+)
+from predictionio_tpu.data.webhooks.examples import (
+    MAILCHIMP_EXAMPLES, SEGMENTIO_EXAMPLES,
+)
+from predictionio_tpu.data.webhooks.mailchimp import (
+    MailChimpConnector, parse_mailchimp_datetime,
+)
+from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+
+
+# ---------------------------------------------------------------------------
+# segment.io: all six message types + malformed payloads
+# ---------------------------------------------------------------------------
+
+class TestSegmentIO:
+    @pytest.mark.parametrize("typ", sorted(SEGMENTIO_EXAMPLES))
+    def test_every_type_converts(self, typ):
+        payload = SEGMENTIO_EXAMPLES[typ]
+        ev = to_event(SegmentIOConnector(), payload)
+        assert ev.event == typ
+        assert ev.entity_type == "user"
+        assert ev.entity_id in (payload.get("user_id"),
+                                payload.get("anonymous_id"))
+        assert ev.event_time.year == 2015
+        if payload.get("context") is not None:
+            assert ev.properties.get("context")["ip"] == "8.8.8.8"
+
+    def test_track_carries_event_name(self):
+        j = SegmentIOConnector().to_event_json(SEGMENTIO_EXAMPLES["track"])
+        assert j["properties"]["event"] == "Registered"
+        assert j["properties"]["properties"]["plan"] == "Pro Annual"
+
+    def test_missing_version(self):
+        bad = {k: v for k, v in SEGMENTIO_EXAMPLES["track"].items()
+               if k != "version"}
+        with pytest.raises(ConnectorException, match="API version"):
+            SegmentIOConnector().to_event_json(bad)
+
+    def test_unknown_type(self):
+        with pytest.raises(ConnectorException, match="unknown type"):
+            SegmentIOConnector().to_event_json(
+                {"version": 2, "type": "purchase", "user_id": "u"})
+
+    def test_missing_user(self):
+        bad = {k: v for k, v in SEGMENTIO_EXAMPLES["identify"].items()
+               if k != "user_id"}
+        with pytest.raises(ConnectorException, match="anonymousId"):
+            SegmentIOConnector().to_event_json(bad)
+
+    def test_missing_required_field(self):
+        # track without its event name; group without group_id
+        bad = {k: v for k, v in SEGMENTIO_EXAMPLES["track"].items()
+               if k != "event"}
+        with pytest.raises(ConnectorException, match="missing event"):
+            SegmentIOConnector().to_event_json(bad)
+        bad = {k: v for k, v in SEGMENTIO_EXAMPLES["group"].items()
+               if k != "group_id"}
+        with pytest.raises(ConnectorException, match="missing group_id"):
+            SegmentIOConnector().to_event_json(bad)
+
+
+# ---------------------------------------------------------------------------
+# MailChimp: all six callback types + malformed payloads
+# ---------------------------------------------------------------------------
+
+class TestMailChimp:
+    @pytest.mark.parametrize("typ", sorted(MAILCHIMP_EXAMPLES))
+    def test_every_type_converts(self, typ):
+        ev = to_event(MailChimpConnector(), MAILCHIMP_EXAMPLES[typ])
+        assert ev.event == typ
+        assert ev.event_time.year == 2009
+
+    def test_subscribe_shape(self):
+        j = MailChimpConnector().to_event_json(
+            MAILCHIMP_EXAMPLES["subscribe"])
+        assert j["entityType"] == "user" and j["entityId"] == "8a25ff1d98"
+        assert j["targetEntityType"] == "list"
+        assert j["targetEntityId"] == "a6b5da1054"
+        assert j["properties"]["merges"]["FNAME"] == "MailChimp"
+        assert j["properties"]["merges"]["INTERESTS"] == "Group1,Group2"
+
+    def test_datetime_parse(self):
+        assert (parse_mailchimp_datetime("2009-03-26 21:35:57")
+                == "2009-03-26T21:35:57Z")
+        with pytest.raises(ConnectorException, match="fired_at"):
+            parse_mailchimp_datetime("26/03/2009")
+
+    def test_missing_and_unknown_type(self):
+        with pytest.raises(ConnectorException, match="'type' is required"):
+            MailChimpConnector().to_event_json({"fired_at": "x"})
+        with pytest.raises(ConnectorException, match="unknown MailChimp"):
+            MailChimpConnector().to_event_json({"type": "pong"})
+
+    def test_missing_required_field(self):
+        bad = {k: v for k, v in MAILCHIMP_EXAMPLES["subscribe"].items()
+               if k != "data[email]"}
+        with pytest.raises(ConnectorException, match="data\\[email\\]"):
+            MailChimpConnector().to_event_json(bad)
+
+    def test_default_registries(self):
+        assert isinstance(default_json_connectors()["segmentio"],
+                          SegmentIOConnector)
+        assert isinstance(default_form_connectors()["mailchimp"],
+                          MailChimpConnector)
+
+
+# ---------------------------------------------------------------------------
+# wire level: channel-scoped ingestion through the EventAPI
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def api(memory_storage):
+    app_id = memory_storage.get_meta_data_apps().insert(
+        App(0, "HookApp", None))
+    memory_storage.get_events().init(app_id)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("hook-key", app_id, ()))
+    cid = memory_storage.get_meta_data_channels().insert(
+        Channel(0, "mobile", app_id))
+    memory_storage.get_events().init(app_id, cid)
+    a = EventAPI(storage=memory_storage, config=EventServerConfig())
+    a.app_id = app_id
+    return a
+
+
+class TestWebhookWire:
+    def test_segmentio_channel_scoped_201(self, api):
+        q = {"accessKey": "hook-key", "channel": "mobile"}
+        status, body = api.handle(
+            "POST", "/webhooks/segmentio.json", q,
+            json.dumps(SEGMENTIO_EXAMPLES["track"]).encode())
+        assert status == 201 and body["eventId"]
+        # visible on that channel...
+        status, events = api.handle("GET", "/events.json", q)
+        assert status == 200 and events[0]["event"] == "track"
+        # ...and NOT on the default channel (channel separation)
+        status, _ = api.handle("GET", "/events.json",
+                               {"accessKey": "hook-key"})
+        assert status == 404
+
+    def test_mailchimp_form_201(self, api):
+        body = urllib.parse.urlencode(
+            MAILCHIMP_EXAMPLES["subscribe"]).encode()
+        status, out = api.handle(
+            "POST", "/webhooks/mailchimp.form",
+            {"accessKey": "hook-key"}, body)
+        assert status == 201 and out["eventId"]
+        status, events = api.handle("GET", "/events.json",
+                                    {"accessKey": "hook-key"})
+        assert status == 200 and events[0]["event"] == "subscribe"
+        assert events[0]["properties"]["merges"]["LNAME"] == "API"
+
+    def test_malformed_payload_400(self, api):
+        q = {"accessKey": "hook-key"}
+        status, body = api.handle(
+            "POST", "/webhooks/segmentio.json", q, b"{not json")
+        assert status == 400
+        status, body = api.handle(
+            "POST", "/webhooks/segmentio.json", q,
+            json.dumps({"type": "track"}).encode())   # no version
+        assert status == 400 and "version" in body["message"]
+        status, body = api.handle(
+            "POST", "/webhooks/mailchimp.form", q,
+            urllib.parse.urlencode({"type": "subscribe"}).encode())
+        assert status == 400 and "required" in body["message"]
+
+    def test_auth_and_unknown_connector(self, api):
+        status, body = api.handle(
+            "POST", "/webhooks/segmentio.json", {"accessKey": "wrong"},
+            json.dumps(SEGMENTIO_EXAMPLES["track"]).encode())
+        assert status == 401
+        status, body = api.handle(
+            "POST", "/webhooks/segmentio.json",
+            {"accessKey": "hook-key", "channel": "nope"},
+            json.dumps(SEGMENTIO_EXAMPLES["track"]).encode())
+        assert status == 401 and "Invalid channel" in body["message"]
+        status, body = api.handle(
+            "POST", "/webhooks/zapier.json", {"accessKey": "hook-key"},
+            b"{}")
+        assert status == 404 and "not supported" in body["message"]
+
+    def test_presence_checks(self, api):
+        q = {"accessKey": "hook-key"}
+        assert api.handle("GET", "/webhooks/segmentio.json", q)[0] == 200
+        assert api.handle("GET", "/webhooks/mailchimp.form", q)[0] == 200
+        assert api.handle("GET", "/webhooks/zapier.json", q)[0] == 404
+        assert api.handle("GET", "/webhooks/segmentio.form", q)[0] == 404
