@@ -1710,6 +1710,7 @@ def _quant_hbm_ceiling_demo():
 _ROUTER_REPLICA_SCRIPT = """\
 import sys
 port, url = int(sys.argv[1]), sys.argv[2]
+partition = sys.argv[3] if len(sys.argv) > 3 else ""
 from predictionio_tpu.data.storage import Storage
 from predictionio_tpu.workflow.create_server import (
     QueryAPI, ServerConfig, serve,
@@ -1722,7 +1723,8 @@ storage = Storage(env={
     "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
 })
 api = QueryAPI(storage=storage,
-               config=ServerConfig(batching="on", aot="off"))
+               config=ServerConfig(batching="on", aot="off",
+                                   partition=partition))
 serve(api, host="127.0.0.1", port=port)
 """
 
@@ -1954,6 +1956,376 @@ def measure_router(n_conns: int = 8, queries_per_client: int = 60):
         except Exception:
             pass
         shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
+class _RouterFleet:
+    """Shared fixture for the partition/cache router legs: the small
+    importable-factory model trained on its OWN storage (never the bench
+    storage's latest COMPLETED instance), served to replica subprocesses
+    over the remote-storage RPC server, plus the keep-alive pump."""
+
+    def __init__(self, prefix: str):
+        import socket
+
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App, Storage
+        from predictionio_tpu.data.storage.remote import serve_storage
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+        )
+        from predictionio_tpu.workflow import run_train
+        from predictionio_tpu.workflow.context import WorkflowContext
+        import datetime as _dt
+
+        self._socket = socket
+        self.workdir = tempfile.mkdtemp(prefix=prefix)
+        self.storage = Storage(env={
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": os.path.join(self.workdir, "el"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        app_id = self.storage.get_meta_data_apps().insert(
+            App(0, "RouterBench"))
+        self.storage.get_events().init(app_id)
+        rng = np.random.default_rng(5)
+        events = []
+        for u in range(64):
+            for i in rng.choice(48, size=12, replace=False).tolist():
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": float(1 + (u * 7 + i) % 5)}),
+                    event_time=_dt.datetime(
+                        2021, 1, 1, tzinfo=_dt.timezone.utc)))
+        self.storage.get_events().insert_batch(events, app_id)
+        run_train(
+            WorkflowContext(storage=self.storage), RecommendationEngine(),
+            EngineParams(
+                data_source_params=DataSourceParams(appName="RouterBench"),
+                algorithm_params_list=(("als", ALSAlgorithmParams(
+                    rank=8, numIterations=3, lambda_=0.05, seed=11)),)),
+            engine_factory=("predictionio_tpu.models.recommendation:"
+                            "RecommendationEngine"),
+            params_json={
+                "datasource": {"params": {"appName": "RouterBench"}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 8, "numIterations": 3, "lambda": 0.05,
+                    "seed": 11}}]})
+        self.rpc_server = serve_storage(self.storage, host="127.0.0.1",
+                                        port=0)
+        self.url = f"http://127.0.0.1:{self.rpc_server.server_address[1]}"
+        self.script = os.path.join(self.workdir, "replica.py")
+        with open(self.script, "w") as f:
+            f.write(_ROUTER_REPLICA_SCRIPT)
+        pythonpath = HERE + os.pathsep + os.environ.get("PYTHONPATH", "")
+        self.env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": pythonpath.rstrip(os.pathsep)}
+        self.procs: list = []
+
+    def free_port(self) -> int:
+        s = self._socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn_replica(self, port: int, partition: str = ""):
+        import subprocess
+        args = [sys.executable, self.script, str(port), self.url]
+        if partition:
+            args.append(partition)
+        proc = subprocess.Popen(args, env=self.env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        self.procs.append(proc)
+        return proc
+
+    def wait_ready(self, port: int, timeout: float = 240.0) -> bool:
+        import http.client
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=2.0)
+                conn.request("GET", "/readyz")
+                ok = conn.getresponse().status == 200
+                conn.close()
+                if ok:
+                    return True
+            except OSError:
+                pass
+            time.sleep(0.25)
+        return False
+
+    def readyz(self, port: int) -> dict:
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5.0)
+        try:
+            conn.request("GET", "/readyz")
+            return json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def query_bytes(self, port: int, body: bytes) -> tuple:
+        """One POST /queries.json; returns (status, raw payload bytes)."""
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10.0)
+        try:
+            conn.request("POST", "/queries.json", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def pump(self, port: int, n_conns: int, queries_per_client: int,
+             body_fn) -> tuple:
+        """n_conns keep-alive clients x queries_per_client requests;
+        ``body_fn(cx, q)`` makes each request body. Returns
+        (qps, p50_ms, p99_ms)."""
+        import http.client
+        import threading
+        socket = self._socket
+        lat_lock = threading.Lock()
+        lat: list = []
+        errors: list = []
+        barrier = threading.Barrier(n_conns + 1)
+
+        def client(cx):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.connect()
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                my = []
+                barrier.wait()
+                for q in range(queries_per_client):
+                    body = body_fn(cx, q)
+                    t0 = time.perf_counter()
+                    conn.request(
+                        "POST", "/queries.json", body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    my.append(time.perf_counter() - t0)
+                    assert resp.status == 200, payload[:200]
+                conn.close()
+                with lat_lock:
+                    lat.extend(my)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(cx,))
+                   for cx in range(n_conns)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        lat_ms = np.asarray(lat) * 1e3
+        return (round(n_conns * queries_per_client / wall, 1),
+                round(float(np.percentile(lat_ms, 50)), 3),
+                round(float(np.percentile(lat_ms, 99)), 3))
+
+    def close(self) -> None:
+        for proc in self.procs:
+            proc.kill()
+        self.rpc_server.shutdown()
+        self.rpc_server.server_close()
+        try:
+            self.storage.get_events().close()
+        except Exception:
+            pass
+        shutil.rmtree(self.workdir, ignore_errors=True)
+
+
+def measure_router_partition(n_conns: int = 6,
+                             queries_per_client: int = 40,
+                             n_partitions: int = 2):
+    """Partition-routed serving leg (workflow/router.py scatter/merge +
+    `pio deploy --partition i/N`): one FULL replica is the baseline,
+    ``n_partitions`` row-range replicas behind the router are the
+    system under test. Reports:
+
+    - bit-parity: every user's wire answer through the partition fleet
+      must equal the full replica's raw bytes (deterministic — checked
+      on every host);
+    - ``router_partition_added_p99_ms``: scatter+merge p99 over the
+      direct full-replica p99 (the price of 1/N-catalog replicas);
+    - the HBM-budget demo: per-replica item-factor bytes drop to ~1/N,
+      so a demo budget sized UNDER the full model but OVER one
+      partition serves only via the fleet — the "catalog 10x the mesh"
+      story with honest numbers from /readyz metadata."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    capable = cores >= 4
+    fleet = _RouterFleet("pio_router_part_")
+    out: dict = {"router_partition_gate_capable": capable,
+                 "router_partition_width": n_partitions}
+    routers = []
+    try:
+        from predictionio_tpu.data.api.http import serve_background
+        from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
+        full_port = fleet.free_port()
+        part_ports = [fleet.free_port() for _ in range(n_partitions)]
+        fleet.spawn_replica(full_port)
+        for idx, p in enumerate(part_ports):
+            fleet.spawn_replica(p, partition=f"{idx}/{n_partitions}")
+        for p in [full_port] + part_ports:
+            if not fleet.wait_ready(p):
+                raise RuntimeError(f"replica on port {p} never ready")
+        # HBM-budget demo from the advertised ranges: rank-8 fp32 rows
+        ready = fleet.readyz(part_ports[0])
+        part = ready.get("partition") or {}
+        rank = 8
+        full_bytes = int(part.get("nItems", 0)) * rank * 4
+        part_bytes = int(part.get("rows", 0)) * rank * 4
+        budget = int(full_bytes * 0.6)
+        out["router_partition_item_bytes_full"] = full_bytes
+        out["router_partition_item_bytes_each"] = part_bytes
+        out["router_partition_demo_budget_bytes"] = budget
+        out["router_partition_full_fits_budget"] = bool(
+            full_bytes <= budget)
+        out["router_partition_each_fits_budget"] = bool(
+            part_bytes <= budget)
+        out["router_partition_catalog_multiple"] = n_partitions
+        router = RouterAPI(RouterConfig(
+            backends=tuple(f"http://127.0.0.1:{p}" for p in part_ports),
+            health_ms=100.0))
+        routers.append(router)
+        rserver, rport = serve_background(router)
+        try:
+            deadline = time.perf_counter() + 20.0
+            while time.perf_counter() < deadline:
+                if router.handle("GET", "/readyz")[0] == 200 and \
+                        router._pmap is not None:
+                    break
+                time.sleep(0.1)
+            if router._pmap is None:
+                raise RuntimeError("partition map never became complete")
+            # bit-parity over the wire: every trained user, ties and all
+            mismatches = 0
+            for u in range(64):
+                body = json.dumps({"user": f"u{u}", "num": 10}).encode()
+                s_full, b_full = fleet.query_bytes(full_port, body)
+                s_part, b_part = fleet.query_bytes(rport, body)
+                if not (s_full == s_part == 200 and b_full == b_part):
+                    mismatches += 1
+            out["router_partition_parity_mismatches"] = mismatches
+            out["router_partition_parity_ok"] = mismatches == 0
+
+            def body_fn(cx, q):
+                return json.dumps(
+                    {"user": f"u{(cx * 131 + q * 17) % 64}",
+                     "num": 10}).encode()
+
+            fleet.pump(full_port, n_conns, queries_per_client, body_fn)
+            qps_d, p50_d, p99_d = fleet.pump(
+                full_port, n_conns, queries_per_client, body_fn)
+            out["router_partition_direct"] = {
+                "qps": qps_d, "p50_ms": p50_d, "p99_ms": p99_d}
+            fleet.pump(rport, n_conns, queries_per_client, body_fn)
+            qps_s, p50_s, p99_s = fleet.pump(
+                rport, n_conns, queries_per_client, body_fn)
+            out["router_partition_scatter"] = {
+                "qps": qps_s, "p50_ms": p50_s, "p99_ms": p99_s}
+            out["router_partition_added_p50_ms"] = round(p50_s - p50_d, 3)
+            out["router_partition_added_p99_ms"] = round(p99_s - p99_d, 3)
+        finally:
+            rserver.shutdown()
+            router.close()
+    finally:
+        fleet.close()
+    return out
+
+
+def measure_router_cache(n_conns: int = 6, queries_per_client: int = 80,
+                         exponent: float = 1.1):
+    """Front-door response-cache leg (workflow/router.py
+    _ResponseCache): the SAME zipfian key stream (data/synthetic.py
+    ``query_keys`` — rank-0-hottest, the workload real front doors see)
+    pumped through the router with the cache off, then on. Reports the
+    measured hit ratio (> 0 gated everywhere: the stream repeats keys
+    by construction) and cached-vs-uncached p99; the p99 gate
+    (cached <= uncached) is enforced on >= 4-core hosts under
+    BENCH_STRICT_EXTRAS=1 — on a shared core the router, both replicas
+    and the clients fight for one CPU and the delta measures the host
+    (``router_cache_gate_capable`` records the honest skip)."""
+    from predictionio_tpu.data.synthetic import query_keys
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    capable = cores >= 4
+    fleet = _RouterFleet("pio_router_cache_")
+    out: dict = {"router_cache_gate_capable": capable,
+                 "router_cache_zipf_exponent": exponent}
+    keys = query_keys(n_conns * queries_per_client, seed=7,
+                      exponent=exponent, pool=64)
+
+    def body_fn(cx, q):
+        return json.dumps(
+            {"user": f"u{int(keys[cx * queries_per_client + q])}",
+             "num": 10}).encode()
+
+    try:
+        from predictionio_tpu.data.api.http import serve_background
+        from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
+        ports = [fleet.free_port() for _ in range(2)]
+        for p in ports:
+            fleet.spawn_replica(p)
+        for p in ports:
+            if not fleet.wait_ready(p):
+                raise RuntimeError(f"replica on port {p} never ready")
+        backends = tuple(f"http://127.0.0.1:{p}" for p in ports)
+        for cache_on in (False, True):
+            router = RouterAPI(RouterConfig(
+                backends=backends, health_ms=100.0,
+                cache="on" if cache_on else "off",
+                cache_mb=16, cache_ttl_ms=60_000.0))
+            rserver, rport = serve_background(router)
+            try:
+                # warm pass: compiles/caches on the replicas, and (on
+                # the cached run) fills the LRU with the hot keys
+                fleet.pump(rport, n_conns, queries_per_client, body_fn)
+                qps, p50, p99 = fleet.pump(
+                    rport, n_conns, queries_per_client, body_fn)
+                label = "router_cache" if cache_on else "router_uncached"
+                out[label] = {"qps": qps, "p50_ms": p50, "p99_ms": p99}
+                if cache_on:
+                    stats = (router.handle("GET", "/")[1]
+                             .get("cache") or {})
+                    out["router_cache_hit_ratio"] = round(
+                        float(stats.get("hitRatio") or 0.0), 4)
+                    out["router_cache_hits"] = stats.get("hits")
+                    out["router_cache_misses"] = stats.get("misses")
+                    out["router_cache_evictions"] = stats.get("evictions")
+                    out["router_cache_p99_ms"] = p99
+                else:
+                    out["router_uncached_p99_ms"] = p99
+            finally:
+                rserver.shutdown()
+                router.close()
+        out["router_cache_hit_ratio_ok"] = bool(
+            (out.get("router_cache_hit_ratio") or 0.0) > 0.0)
+        out["router_cache_p99_ok"] = bool(
+            out["router_cache_p99_ms"] <= out["router_uncached_p99_ms"])
+    finally:
+        fleet.close()
     return out
 
 
@@ -2758,6 +3130,30 @@ def main() -> None:
             except Exception as e:
                 router_leg = {"router_error": f"{type(e).__name__}: {e}"}
 
+        # partition-routed serving leg (workflow/router.py scatter/
+        # merge + `pio deploy --partition i/N`): wire bit-parity vs one
+        # full replica (deterministic, gated everywhere), scatter-added
+        # p99, and the 1/N per-replica HBM-budget demo
+        partition_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                partition_leg = measure_router_partition()
+            except Exception as e:
+                partition_leg = {"router_partition_error":
+                                 f"{type(e).__name__}: {e}"}
+
+        # front-door response-cache leg (workflow/router.py
+        # _ResponseCache): zipfian keys through the router cache off vs
+        # on — hit ratio > 0 gated everywhere, cached p99 <= uncached
+        # on >= 4-core hosts (router_cache_gate_capable records skips)
+        cache_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                cache_leg = measure_router_cache()
+            except Exception as e:
+                cache_leg = {"router_cache_error":
+                             f"{type(e).__name__}: {e}"}
+
         # multi-tenant leg (serving/registry.py): one process, N engine
         # instances — shared-AOT compile flatness (strict everywhere)
         # and noisy-neighbor p99 isolation (strict on >= 4-core hosts;
@@ -2928,6 +3324,8 @@ def main() -> None:
                 **(shard_leg or {}),
                 **(quant_leg or {}),
                 **(router_leg or {}),
+                **(partition_leg or {}),
+                **(cache_leg or {}),
                 **(mt_leg or {}),
                 **(recompile_watch or {}),
                 **(stream_leg or {}),
@@ -3173,6 +3571,51 @@ def main() -> None:
                         "router 1->2 replica QPS scaling "
                         f"({router_leg.get('router_qps_scaling_2')}x) "
                         "below 1.6x with BENCH_STRICT_EXTRAS=1")
+        if (os.environ.get("BENCH_STRICT_EXTRAS") == "1"
+                and partition_leg):
+            if partition_leg.get("router_partition_error"):
+                failures.append(
+                    "router partition leg crashed "
+                    f"({partition_leg['router_partition_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                # wire bit-parity is deterministic (same merge as the
+                # device all-gather path) — gated on EVERY host
+                if not partition_leg.get("router_partition_parity_ok"):
+                    failures.append(
+                        "partition-routed wire answers diverged from "
+                        "the full replica on "
+                        f"{partition_leg.get('router_partition_parity_mismatches')}"
+                        " queries with BENCH_STRICT_EXTRAS=1")
+                if not partition_leg.get(
+                        "router_partition_each_fits_budget"):
+                    failures.append(
+                        "partition replicas did not fit the demo HBM "
+                        "budget that the full model exceeds with "
+                        "BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and cache_leg:
+            if cache_leg.get("router_cache_error"):
+                failures.append(
+                    "router cache leg crashed "
+                    f"({cache_leg['router_cache_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                # zipfian traffic must hit a warm cache on any host;
+                # the latency win is only gated where cores are real
+                if not cache_leg.get("router_cache_hit_ratio_ok"):
+                    failures.append(
+                        "router response cache hit ratio "
+                        f"({cache_leg.get('router_cache_hit_ratio')}) "
+                        "was 0 under zipfian keys with "
+                        "BENCH_STRICT_EXTRAS=1")
+                if (cache_leg.get("router_cache_gate_capable")
+                        and not cache_leg.get("router_cache_p99_ok")):
+                    failures.append(
+                        "cached p99 "
+                        f"({cache_leg.get('router_cache_p99_ms')} ms) "
+                        "did not beat uncached p99 "
+                        f"({cache_leg.get('router_uncached_p99_ms')} ms)"
+                        " with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and mt_leg:
             if mt_leg.get("multitenant_error"):
                 failures.append(

@@ -230,6 +230,23 @@ ENV_VARS: Dict[str, str] = {
         "router per-tenant in-flight cap: concurrent forwards for one "
         "tenant (resolved from the query's accessKey) beyond this shed "
         "503 without charging the shared ceiling (default 0 = off)",
+    "PIO_ROUTER_CACHE":
+        "router front-door response cache on/off: repeat (tenant, query "
+        "bytes, model generation) hits answer from a bounded LRU "
+        "without touching a replica; generation keying makes /reload "
+        "invalidation free, per tenant (default off)",
+    "PIO_ROUTER_CACHE_MB":
+        "router response-cache byte budget in MB — least-recently-used "
+        "entries evict past it (default 16)",
+    "PIO_ROUTER_CACHE_TTL_MS":
+        "router response-cache entry TTL in ms; bounds the staleness "
+        "generation keying cannot see, e.g. fold-in row publishes "
+        "(KNOWN_ISSUES #17; default 5000)",
+    "PIO_DEPLOY_PARTITION":
+        "partition-routed deploy scope i/N for `pio deploy`: this "
+        "replica loads only its contiguous item-row range "
+        "(parallel/serve_dist.py partition_rows) and advertises it on "
+        "/readyz for the router's scatter/merge (default: full model)",
     # ------------------------------------------------------ multi-tenant
     "PIO_TENANT_RATE":
         "default per-access-key admission rate in queries/s for "
@@ -416,6 +433,25 @@ METRICS: Dict[str, str] = {
     "pio_router_backend_up":
         "1 while a backend is in rotation (healthy + admitted by the "
         "reload barrier), 0 while ejected",
+    "pio_router_cache_hits_total":
+        "front-door response-cache hits: queries answered from the "
+        "(tenant, query bytes, model generation) LRU without touching "
+        "a replica",
+    "pio_router_cache_misses_total":
+        "front-door response-cache misses (forwarded to a replica; 200 "
+        "answers are stored on the way back)",
+    "pio_router_cache_evictions_total":
+        "response-cache entries dropped: LRU past the byte budget, TTL "
+        "expiry, or a generation-bump invalidation sweep",
+    "pio_router_cache_hit_ratio":
+        "hits / (hits + misses) over the router's lifetime — the "
+        "zipfian hot-key absorption the cache exists for",
+    "pio_router_partition_requests_total":
+        "partition-scattered /queries.json requests by outcome (merged "
+        "/ coverage_gap / error / deadline)",
+    "pio_router_partition_width":
+        "scatter width of the live partition map (how many owning "
+        "partitions one query fans out to); 0 = no map",
     # ----------------------------------------------------------- transport
     "pio_http_requests_total": "HTTP requests by path/code",
     "pio_http_request_seconds": "HTTP request handling latency",

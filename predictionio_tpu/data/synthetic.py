@@ -82,6 +82,26 @@ def _zipf_cdf(n: int, exponent: float) -> np.ndarray:
     return np.cumsum(w / w.sum())
 
 
+def query_keys(n: int, seed: int, exponent: float = 1.1,
+               pool: int = 1024) -> np.ndarray:
+    """``n`` seeded zipfian key indices in [0, pool) — rank 0 hottest.
+
+    The bench pumps these through the router so cache-hit-ratio and
+    hot-key legs measure the skewed workload real front doors see,
+    instead of uniform-random keys that defeat any cache. Same draw as
+    ``chunk_codes``: a counter-derived Philox stream + searchsorted over
+    ``_zipf_cdf``, so every (n, seed, exponent, pool) is reproducible
+    across hosts."""
+    if n <= 0:
+        return np.empty(0, dtype=np.int32)
+    cdf = _zipf_cdf(max(int(pool), 1), exponent)
+    rng = np.random.default_rng(
+        np.random.SeedSequence(entropy=seed, spawn_key=(0x51c,)))
+    keys = np.searchsorted(cdf, rng.random(n)).astype(np.int32)
+    np.clip(keys, 0, len(cdf) - 1, out=keys)
+    return keys
+
+
 class ChunkSource:
     """Re-iterable chunk stream over one :class:`SyntheticConfig`.
 

@@ -157,6 +157,60 @@ def serving_enabled(mode: Optional[str] = None) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# partition-routed serving (cross-process twin of the on-device merge)
+# ---------------------------------------------------------------------------
+
+def parse_partition(spec: str) -> Tuple[int, int]:
+    """Parse a ``pio deploy --partition i/N`` scope into (index, count).
+
+    ``i`` is zero-based and must satisfy 0 <= i < N; N >= 1. Raises
+    ValueError on anything else so a typo'd fleet never silently serves
+    the wrong rows."""
+    txt = str(spec).strip()
+    try:
+        left, right = txt.split("/", 1)
+        index, count = int(left), int(right)
+    except ValueError:
+        raise ValueError(
+            f"--partition must look like i/N (got {spec!r})") from None
+    if count < 1 or not 0 <= index < count:
+        raise ValueError(
+            f"--partition index out of range: {index}/{count}")
+    return index, count
+
+
+def partition_rows(n_items: int, index: int, count: int) -> Tuple[int, int]:
+    """Contiguous row range [lo, hi) owned by partition ``index`` of
+    ``count``: the same floor split every partition computes
+    independently, so the fleet tiles [0, n_items) exactly."""
+    lo = index * n_items // count
+    hi = (index + 1) * n_items // count
+    return lo, hi
+
+
+def merge_candidates(values, gids, k: int):
+    """Host-side twin of the kernel's final merge: two-key stable sort by
+    (-value, global index ascending), truncated to ``k``.
+
+    ``values``/``gids`` are the concatenated per-partition top-k
+    candidates for ONE query. Returns (merged_values, merged_gids,
+    order) where ``order`` indexes into the concatenated inputs — the
+    router uses it to reorder already-parsed response entries so the
+    merged wire answer reuses the replicas' own floats byte-for-byte.
+
+    Tie rule matches ``topk_for_users_sharded``'s
+    ``lax.sort((-cand_v, cand_g), num_keys=2)`` for every finite score;
+    the one divergence is IEEE total order on signed zeros (-0.0 sorts
+    before +0.0 on device, equal here) — ALS scores are dot products
+    where a -0.0 tie with +0.0 at the k boundary has never been
+    observed, and the parity tests construct ties with nonzero values."""
+    v = np.asarray(values)
+    g = np.asarray(gids)
+    order = np.lexsort((g, -v))[:max(int(k), 0)]
+    return v[order], g[order], order
+
+
+# ---------------------------------------------------------------------------
 # the sharded serving kernel
 # ---------------------------------------------------------------------------
 

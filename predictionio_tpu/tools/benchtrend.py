@@ -118,6 +118,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # scaling regression is visible round over round
     ("router_added_p99_ms", "down", False),
     ("router_qps_scaling_2", "up", False),
+    # partition-routing + response-cache era (workflow/router.py
+    # scatter/merge + _ResponseCache): the p99 the 1/N-catalog scatter
+    # ADDS over one full replica, the zipfian hot-key hit ratio the
+    # front-door cache absorbs, and the cached-path p99 itself —
+    # trended so merge overhead growth or a cache-efficiency regression
+    # is visible round over round
+    ("router_partition_added_p99_ms", "down", False),
+    ("router_cache_hit_ratio", "up", False),
+    ("router_cache_p99_ms", "down", False),
     # multi-tenant era (serving/registry.py): noisy-neighbor isolation
     # — tenant B's p99 under tenant A's flood over B's solo p99
     # (hard-gated at <= 3x by the bench's multitenant leg under

@@ -240,6 +240,7 @@ def cmd_deploy(args) -> int:
         foldin=args.foldin,
         foldin_tick_ms=args.foldin_tick_ms,
         foldin_headroom=args.foldin_headroom,
+        partition=getattr(args, "partition", "") or "",
     )
     if args.compile_cache:
         os.environ["PIO_COMPILE_CACHE_DIR"] = args.compile_cache
@@ -397,7 +398,10 @@ def cmd_router(args) -> int:
         ip=args.ip, port=args.port,
         health_ms=args.health_ms,
         deadline_ms=args.deadline_ms,
-        max_inflight=args.max_inflight)
+        max_inflight=args.max_inflight,
+        cache=getattr(args, "cache", "") or "",
+        cache_mb=getattr(args, "cache_mb", 0) or 0,
+        cache_ttl_ms=getattr(args, "cache_ttl_ms", 0.0) or 0.0)
     api = RouterAPI(config)
     _info(f"Router is live at http://{args.ip}:{args.port} over "
           f"{len(api.backends)} backend(s).")
@@ -809,6 +813,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--foldin-headroom", type=int, default=0,
                     help="user-row capacity pre-padded for fold-in "
                          "appends (0 = PIO_FOLDIN_HEADROOM or 1024)")
+    sp.add_argument("--partition", default="",
+                    help="partition-routed deploy scope i/N (e.g. 0/4): "
+                         "serve only the owned contiguous item-row "
+                         "range — the per-replica model shrinks to "
+                         "~1/N and `pio router` scatters each query "
+                         "over all N partitions and merges bit-"
+                         "identically (PIO_DEPLOY_PARTITION overrides; "
+                         "default: full model)")
     sp.add_argument("--slo-availability", type=float, default=None,
                     help="availability SLO target, e.g. 0.999 "
                          "(default PIO_SLO_AVAILABILITY or 0.999)")
@@ -951,6 +963,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--max-inflight", type=int, default=0,
                     help="admission ceiling before 503 + Retry-After "
                          "(0 = PIO_ROUTER_MAX_INFLIGHT or 256)")
+    sp.add_argument("--cache", choices=("on", "off"), default="",
+                    help="front-door response cache: answer repeat "
+                         "(tenant, query bytes, model generation) hits "
+                         "from a bounded LRU without touching a replica "
+                         "— a /reload invalidates by construction, per "
+                         "tenant (default PIO_ROUTER_CACHE or off)")
+    sp.add_argument("--cache-mb", type=int, default=0,
+                    help="response-cache byte budget in MB (0 = "
+                         "PIO_ROUTER_CACHE_MB or 16)")
+    sp.add_argument("--cache-ttl-ms", type=float, default=0.0,
+                    help="response-cache entry TTL in ms — bounds "
+                         "fold-in staleness, KNOWN_ISSUES #17 (0 = "
+                         "PIO_ROUTER_CACHE_TTL_MS or 5000)")
     telemetry_flags(sp)
 
     sp = sub.add_parser("eventserver", help="start the event server")

@@ -313,7 +313,42 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
         shed = root.get("shedCount") or 0
         if shed:
             detail += f", {shed} shed (503)"
-        if in_rot == 0:
+        parts = root.get("partitions")
+        gap = False
+        if isinstance(parts, dict):
+            owners = parts.get("owners") or {}
+            ranges = "; ".join(
+                f"p{i}=[{min(o['lo'] for o in os_)},"
+                f"{max(o['hi'] for o in os_)})x{len(os_)}"
+                for i, os_ in sorted(owners.items(),
+                                     key=lambda kv: int(kv[0])) if os_)
+            if parts.get("complete"):
+                detail += (f", partition map {parts.get('count')} wide "
+                           f"gen {parts.get('generation')} "
+                           f"({ranges or 'no ranges'})")
+            else:
+                gap = True
+        cache = root.get("cache")
+        cache_cold = False
+        if isinstance(cache, dict) and cache.get("enabled"):
+            looked = (cache.get("hits") or 0) + (cache.get("misses") or 0)
+            ratio = cache.get("hitRatio") or 0.0
+            detail += (f", cache {cache.get('entries', 0)} entries "
+                       f"hit-ratio {ratio:.1%}")
+            # enabled but ~0% under real traffic: the keys are probably
+            # unique per request (timestamps in the body?) or the TTL
+            # is shorter than the key re-visit interval
+            cache_cold = looked >= 20 and ratio < 0.01
+        if gap:
+            owners = (parts or {}).get("owners") or {}
+            covered = sorted(owners.keys(), key=int)
+            checks.append(("router", RED,
+                           "partition COVERAGE GAP — partition replicas "
+                           "are advertised but no complete same-"
+                           "generation map is in rotation (covered "
+                           f"indices: {covered or 'none'}); partition "
+                           "queries answer 503, never a partial merge"))
+        elif in_rot == 0:
             checks.append(("router", RED,
                            "NO backend in rotation — every query sheds "
                            f"503 ({per})"))
@@ -332,6 +367,12 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
         elif any(b.get("breaker") == "open" for b in backends):
             checks.append(("router", WARN,
                            detail + " — a backend breaker is open"))
+        elif cache_cold:
+            checks.append(("router", WARN,
+                           detail + " — response cache is enabled but "
+                           "~0% of lookups hit under traffic: query "
+                           "bodies are probably unique per request, or "
+                           "the TTL is below the key re-visit interval"))
         else:
             checks.append(("router", OK, detail))
 

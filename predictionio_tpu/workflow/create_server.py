@@ -22,6 +22,7 @@ import dataclasses
 import itertools
 import json
 import logging
+import os
 import random
 import string
 import threading
@@ -136,6 +137,17 @@ class ServerConfig:
     #: user-row capacity headroom pre-padded at load for fold-in
     #: appends (0 = PIO_FOLDIN_HEADROOM or 1024)
     foldin_headroom: int = 0
+    #: partition-routed deploy (parallel/serve_dist.py helpers +
+    #: workflow/router.py scatter/merge): "i/N" scopes this replica to
+    #: the contiguous item-row range partition_rows(n_items, i, N) —
+    #: item factors AND item vocab are sliced before prepare_serving,
+    #: so sharding/quant/AOT/fold-in all see only the owned rows and
+    #: per-replica HBM drops to ~1/N. /readyz and GET / advertise the
+    #: owned range; /queries.json responses carry the candidates'
+    #: global indices so the router's merge_candidates twin reassembles
+    #: a bit-identical full-model answer. "" (default) keeps every
+    #: endpoint wire-byte identical. PIO_DEPLOY_PARTITION overrides.
+    partition: str = ""
     #: multi-tenant deploy (serving/registry.py): the parsed
     #: ``pio deploy --engines conf.json`` tenant specs. Empty () is the
     #: legacy single-engine server — every endpoint stays wire-byte
@@ -229,6 +241,44 @@ def prepare_deploy(ctx, engine: Engine, engine_params: EngineParams,
     return out
 
 
+def _partition_models(models: List[Any], index: int,
+                      count: int) -> Tuple[List[Any], Dict[str, Any]]:
+    """Slice every partitionable model down to the item rows partition
+    ``index`` of ``count`` owns (parallel/serve_dist.py:partition_rows).
+
+    A model is partitionable when it exposes ``item_factors`` + an
+    ``item_vocab`` BiMap (the ALSModel shape). The slice is
+    order-preserving — global item index ``g`` in [lo, hi) becomes local
+    index ``g - lo`` — so the replica's local two-key top-k tie order
+    equals the full model's order over those rows, which is what makes
+    the router's merge_candidates reassembly bit-identical. The vocab is
+    rebuilt over the owned rows only, so predict paths, k-clamping
+    (min(num, len(item_vocab))) and name lookups all work unchanged."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.parallel.serve_dist import partition_rows
+    state: Optional[Dict[str, Any]] = None
+    out: List[Any] = []
+    for m in models:
+        fac = getattr(m, "item_factors", None)
+        vocab = getattr(m, "item_vocab", None)
+        if fac is None or vocab is None:
+            out.append(m)
+            continue
+        n_items = len(vocab)
+        lo, hi = partition_rows(n_items, index, count)
+        inv = vocab.inverse()
+        sliced_vocab = BiMap({inv(g): g - lo for g in range(lo, hi)})
+        out.append(dataclasses.replace(
+            m, item_factors=fac[lo:hi], item_vocab=sliced_vocab))
+        state = {"index": index, "count": count, "lo": lo, "hi": hi,
+                 "rows": hi - lo, "nItems": n_items}
+    if state is None:
+        raise ValueError(
+            f"--partition {index}/{count} requested but no deployed model "
+            "exposes item_factors + item_vocab to slice")
+    return out, state
+
+
 class QueryAPI:
     """Pure route handler for the engine server (ServerActor routes,
     CreateServer.scala:384-693)."""
@@ -293,6 +343,15 @@ class QueryAPI:
         self._aot_state: Optional[Dict[str, Any]] = None
         self._shard_state: Optional[Dict[str, Any]] = None
         self._quant_state: Optional[Dict[str, Any]] = None
+        #: partition-routed deploy: the owned item-row range advertised
+        #: on /readyz and GET /; None = full-model replica (wire parity)
+        self._partition_state: Optional[Dict[str, Any]] = None
+        self._partition_spec = (self.config.partition
+                                or os.environ.get("PIO_DEPLOY_PARTITION", ""))
+        if self._partition_spec and self.config.tenants:
+            raise ValueError(
+                "--partition is a single-engine deploy scope; it does not "
+                "compose with --engines multi-tenancy")
         #: realtime fold-in worker (realtime/foldin.py) — one per
         #: server, re-bound to each model generation by _load
         self._foldin_worker = None
@@ -349,6 +408,15 @@ class QueryAPI:
         models = prepare_deploy(
             self.ctx, engine, engine_params, instance.id, models,
             algorithms=algorithms)
+        # partition scope: slice the owned item rows FIRST, so fold-in
+        # padding, sharded/quant layouts, AOT program shapes and the
+        # batcher all see only this replica's 1/N of the catalog
+        partition_state = None
+        if self._partition_spec:
+            from predictionio_tpu.parallel import serve_dist as dist_mod
+            p_index, p_count = dist_mod.parse_partition(self._partition_spec)
+            models, partition_state = _partition_models(
+                models, p_index, p_count)
         # realtime fold-in (realtime/foldin.py): capacity headroom must
         # be padded BEFORE prepare_serving so every layout (replicated,
         # sharded, int8) and every AOT program shape already includes
@@ -421,6 +489,7 @@ class QueryAPI:
             self._aot_state = aot_state
             self._shard_state = shard_state
             self._quant_state = quant_state
+            self._partition_state = partition_state
             old_batcher, self._batcher = self._batcher, batcher
         if old_batcher is not None:   # reload: drain in-flight, then retire
             old_batcher.close()
@@ -944,6 +1013,10 @@ class QueryAPI:
             # fell back (the operator must be able to see the fallback);
             # fp32 deploys keep the exact legacy key set (wire parity)
             out["quant"] = self._quant_state
+        if getattr(self, "_partition_state", None) is not None:
+            # only for --partition deploys: full-model replicas keep the
+            # exact legacy key set (wire parity, asserted by test)
+            out["partition"] = {"enabled": True, **self._partition_state}
         worker = getattr(self, "_foldin_worker", None)
         if worker is not None:
             # only with the fold-in worker live: PIO_FOLDIN=0 deploys
@@ -1011,6 +1084,11 @@ class QueryAPI:
         except Exception as e:
             checks["storage"] = f"{type(e).__name__}: {e}"
             ready = False
+        if self._partition_state is not None:
+            # the owned range rides the readiness probe so the router's
+            # membership poll assembles the partition map in the same
+            # read it learns generation (full replicas: key absent)
+            checks["partition"] = dict(self._partition_state)
         status = 200 if ready else 503
         # generation rides the readiness probe so the router's membership
         # poll learns "which model is this replica on" in the same read
@@ -1220,6 +1298,23 @@ class QueryAPI:
                          "prediction contains non-finite scores (the "
                          "deployed model is numerically invalid); retrain "
                          "or /reload a healthy instance"}
+
+        if (self._partition_state is not None and isinstance(result, dict)
+                and isinstance(result.get("itemScores"), list)):
+            # partition-routed deploy: annotate the local top-k with the
+            # candidates' GLOBAL item indices (local row + lo) so the
+            # router's merge_candidates twin can run the same two-key
+            # (value, lowest-global-index) sort the device merge uses.
+            # The router strips this block before answering the client —
+            # only scatter sub-responses carry it.
+            ps = self._partition_state
+            vocab = next(m.item_vocab for m in models
+                         if getattr(m, "item_vocab", None) is not None)
+            result = {**result, "partition": {
+                **ps,
+                "itemIndices": [vocab(s["item"]) + ps["lo"]
+                                for s in result["itemScores"]],
+            }}
 
         dt = time.perf_counter() - t0
         waterfall.end(rec)   # close the breakdown; offer to /debug/slow.json

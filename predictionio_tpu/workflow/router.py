@@ -48,6 +48,8 @@ chosen backend so ``pio trace`` assembles router→replica trees.
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures
 import dataclasses
 import http.client
 import itertools
@@ -111,6 +113,21 @@ class RouterConfig:
     #: the shared inflight pool. 0 (the default) disables the cap:
     #: single-tenant fleets keep the PR 15 behavior byte for byte.
     tenant_max_inflight: int = 0
+    #: front-door response cache: "on" answers repeat (tenant, query
+    #: bytes, model generation) hits from a bounded LRU without touching
+    #: a replica. The generation in the key makes hot-swap invalidation
+    #: free — a /reload bumps the generation and every old entry is
+    #: unreachable; under multi-tenancy the key uses the PER-TENANT
+    #: generation, so one tenant's reload invalidates only its own
+    #: entries. "off" (the default) keeps every response byte-identical
+    #: to the uncached router. PIO_ROUTER_CACHE overrides.
+    cache: str = ""
+    #: response-cache byte budget in MB (LRU past it); PIO_ROUTER_CACHE_MB
+    cache_mb: int = 0
+    #: response-cache entry TTL in ms — bounds fold-in staleness
+    #: (KNOWN_ISSUES #17: published rows do not bump the generation);
+    #: PIO_ROUTER_CACHE_TTL_MS
+    cache_ttl_ms: float = 0.0
 
     def resolved(self) -> "RouterConfig":
         return dataclasses.replace(
@@ -122,7 +139,16 @@ class RouterConfig:
                           or _env_int("PIO_ROUTER_MAX_INFLIGHT", 256)),
             tenant_max_inflight=(
                 self.tenant_max_inflight
-                or _env_int("PIO_ROUTER_TENANT_MAX_INFLIGHT", 0)))
+                or _env_int("PIO_ROUTER_TENANT_MAX_INFLIGHT", 0)),
+            cache=self.cache or os.environ.get("PIO_ROUTER_CACHE", "off"),
+            cache_mb=(self.cache_mb
+                      or _env_int("PIO_ROUTER_CACHE_MB", 16)),
+            cache_ttl_ms=(self.cache_ttl_ms
+                          or _env_pos("PIO_ROUTER_CACHE_TTL_MS", 5000.0)))
+
+    @property
+    def cache_on(self) -> bool:
+        return str(self.cache).strip().lower() in ("1", "on", "true", "yes")
 
 
 def _parse_backend(url: str) -> Tuple[str, int]:
@@ -137,6 +163,100 @@ def _parse_backend(url: str) -> Tuple[str, int]:
         raise ValueError(
             f"router backend {url!r} must be host:port or http://host:port")
     return host, int(port.rstrip("/"))
+
+
+class _ResponseCache:
+    """Bounded-LRU front-door response cache.
+
+    Keys are ``(tenant, generation-token, raw query bytes)`` — the
+    generation token is the fleet's agreed model generation for that
+    tenant at lookup time, so a hot-swap invalidates by CONSTRUCTION
+    (old entries become unreachable) and a TTL bounds what generation
+    keying cannot see (fold-in row publishes, KNOWN_ISSUES #17). Only
+    200 responses are stored. Thread-safe; sizes are accounted in bytes
+    (query bytes + compact-JSON response bytes) against ``max_bytes``,
+    evicting least-recently-used past it."""
+
+    def __init__(self, max_bytes: int, ttl_s: float):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self._entries: "collections.OrderedDict[Tuple[str, Any, bytes], Tuple[float, int, int, Any, Dict[str, str]]]" = (
+            collections.OrderedDict())
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: Tuple[str, Any, bytes]) -> Optional[Response]:
+        now = time.perf_counter()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            expires, size, status, obj, extra = entry
+            if now >= expires:
+                # expired entries count as evictions, not hits — the
+                # TTL is doing its staleness-bounding job
+                del self._entries[key]
+                self._bytes -= size
+                self.evictions += 1
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return (status, obj, dict(extra)) if extra else (status, obj)
+
+    def put(self, key: Tuple[str, Any, bytes], status: int, obj: Any,
+            extra: Optional[Dict[str, str]] = None) -> int:
+        """Store one response; returns how many entries were evicted."""
+        try:
+            size = len(key[2]) + len(
+                json.dumps(obj, separators=(",", ":")).encode("utf-8"))
+        except (TypeError, ValueError):
+            return 0                      # unserializable — never cache
+        if size > self.max_bytes:
+            return 0
+        evicted = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (time.perf_counter() + self.ttl_s, size,
+                                  status, obj, dict(extra or {}))
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, esize, _, _, _) = self._entries.popitem(last=False)
+                self._bytes -= esize
+                evicted += 1
+            self.evictions += evicted
+        return evicted
+
+    def invalidate_tenant(self, tenant: str) -> int:
+        """Drop every entry of one tenant (its generation moved — the
+        entries are already unreachable; this reclaims their bytes
+        immediately instead of waiting out the TTL)."""
+        with self._lock:
+            stale = [k for k in self._entries if k[0] == tenant]
+            for k in stale:
+                self._bytes -= self._entries.pop(k)[1]
+            self.evictions += len(stale)
+            return len(stale)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            looked = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "maxBytes": self.max_bytes,
+                "ttlMs": round(self.ttl_s * 1e3, 1),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hitRatio": (self.hits / looked) if looked else 0.0,
+            }
 
 
 class _Backend:
@@ -161,6 +281,10 @@ class _Backend:
         #: per-tenant generation ids (multi-tenant backends report a
         #: dict on /readyz; None for a legacy single-engine replica)
         self.tenant_generations: Optional[Dict[str, int]] = None
+        #: the item-shard range this replica owns (partition-routed
+        #: deploys advertise {"index","count","lo","hi","rows","nItems"}
+        #: on /readyz; None for a full-model replica)
+        self.partition: Optional[Dict[str, Any]] = None
         self.draining = False
         #: always-on breaker (unlike the remote driver's opt-in
         #: registry): a fleet front door without one queues on corpses.
@@ -219,16 +343,18 @@ class _Backend:
 
     def probe(self, timeout: float = 2.0
               ) -> Tuple[bool, bool, Optional[int],
-                         Optional[Dict[str, int]]]:
-        """(healthy, draining, generation, tenant_generations) from one
-        /readyz read over a FRESH connection — a pooled keep-alive
-        socket can outlive the listener it connected to, and membership
-        must answer "can a new request reach this replica", not "does
-        an old socket still drain". A 503 body still carries
-        ``status``/``generation`` — a draining replica is
+                         Optional[Dict[str, int]],
+                         Optional[Dict[str, Any]]]:
+        """(healthy, draining, generation, tenant_generations,
+        partition) from one /readyz read over a FRESH connection — a
+        pooled keep-alive socket can outlive the listener it connected
+        to, and membership must answer "can a new request reach this
+        replica", not "does an old socket still drain". A 503 body
+        still carries ``status``/``generation`` — a draining replica is
         distinguishable from a dead one. Multi-tenant replicas also
-        report a per-tenant ``generations`` dict; a legacy replica's
-        body has no such key and the 4th element stays None."""
+        report a per-tenant ``generations`` dict; partition-scoped
+        replicas report the owned item-row range; a legacy replica's
+        body has neither key and those elements stay None."""
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout)
         try:
@@ -236,7 +362,7 @@ class _Backend:
             resp = conn.getresponse()
             status, payload = resp.status, resp.read()
         except _TRANSPORT_ERRORS:
-            return False, False, None, None
+            return False, False, None, None, None
         finally:
             try:
                 conn.close()
@@ -244,6 +370,7 @@ class _Backend:
                 pass
         gen: Optional[int] = None
         tenant_gens: Optional[Dict[str, int]] = None
+        partition: Optional[Dict[str, Any]] = None
         draining = False
         try:
             obj = json.loads(payload)
@@ -254,10 +381,22 @@ class _Backend:
                 if isinstance(raw, dict):
                     tenant_gens = {str(k): int(v)
                                    for k, v in raw.items()}
+                rawp = obj.get("partition")
+                if (isinstance(rawp, dict)
+                        and rawp.get("index") is not None
+                        and rawp.get("count") is not None):
+                    partition = {
+                        "index": int(rawp["index"]),
+                        "count": int(rawp["count"]),
+                        "lo": int(rawp.get("lo", 0)),
+                        "hi": int(rawp.get("hi", 0)),
+                        "rows": int(rawp.get("rows", 0)),
+                        "nItems": int(rawp.get("nItems", 0)),
+                    }
                 draining = obj.get("status") == "draining"
         except (ValueError, TypeError):
             pass
-        return status == 200, draining, gen, tenant_gens
+        return status == 200, draining, gen, tenant_gens, partition
 
     def close(self) -> None:
         with self._idle_lock:
@@ -281,6 +420,9 @@ class _Backend:
             # only for multi-tenant replicas: a legacy fleet's status
             # payload keeps the exact PR 15 key set (wire parity)
             out["generations"] = dict(self.tenant_generations)
+        if self.partition is not None:
+            # only for partition-scoped replicas (same parity rule)
+            out["partition"] = dict(self.partition)
         return out
 
 
@@ -318,6 +460,32 @@ class RouterAPI:
         #: binds from the very first request.
         self._tenant_by_key: Dict[str, str] = {}
         self._tenant_inflight: Dict[str, int] = {}
+        #: partition-routed mode: the current partition map — a snapshot
+        #: {"count","generation","nItems","owners": {index: [backends]}}
+        #: rebuilt after every membership change and swapped ATOMICALLY
+        #: (one attribute assignment under the lock), so no query ever
+        #: sees backends from two maps. None + _pmap_incomplete=False is
+        #: a full-replica fleet (the PR 15/16 path, byte for byte);
+        #: None + True means partition replicas exist but coverage is
+        #: incomplete or generations are mixed — queries answer 503,
+        #: never a partial merge.
+        self._pmap: Optional[Dict[str, Any]] = None
+        self._pmap_incomplete = False
+        #: concurrent scatter legs (lazy: full-replica fleets never pay
+        #: for the pool)
+        self._scatter_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._m_partition_requests = None
+        self._m_partition_width = None
+        #: front-door response cache (None unless --cache/PIO_ROUTER_CACHE
+        #: turns it on: the off path stays byte-identical to PR 16)
+        self._cache: Optional[_ResponseCache] = None
+        self._m_cache_hits = self._m_cache_misses = None
+        self._m_cache_evictions = self._m_cache_ratio = None
+        #: last fleet-agreed generation per tenant ('-' = the scalar
+        #: single-engine generation) — the poller's cache-invalidation
+        #: sweep journals and reclaims on each bump
+        self._cache_gens: Dict[str, Any] = {}
         self.start_time = time.perf_counter()
         self.request_count = 0
         self.shed_count = 0
@@ -348,6 +516,30 @@ class RouterAPI:
             "1 while this backend is in rotation (healthy + admitted by "
             "the reload barrier), 0 while ejected",
             labelnames=("backend",))
+        if self.config.cache_on:
+            self._cache = _ResponseCache(
+                max_bytes=self.config.cache_mb * 1024 * 1024,
+                ttl_s=self.config.cache_ttl_ms / 1e3)
+            self._m_cache_hits = reg.counter(
+                "pio_router_cache_hits_total",
+                "Front-door response-cache hits: queries answered from "
+                "the (tenant, query bytes, model generation) LRU without "
+                "touching a replica").child()
+            self._m_cache_misses = reg.counter(
+                "pio_router_cache_misses_total",
+                "Front-door response-cache misses (forwarded to a "
+                "replica; 200 answers are stored on the way back)"
+            ).child()
+            self._m_cache_evictions = reg.counter(
+                "pio_router_cache_evictions_total",
+                "Response-cache entries dropped: LRU past the byte "
+                "budget, TTL expiry, or a generation-bump invalidation "
+                "sweep").child()
+            self._m_cache_ratio = reg.gauge(
+                "pio_router_cache_hit_ratio",
+                "hits / (hits + misses) over this router's lifetime — "
+                "the zipfian hot-key absorption the cache exists for"
+            ).child()
         # first sweep runs synchronously so a router that starts against
         # a live fleet is ready the moment its own /readyz answers
         self._poll_once(timeout=min(2.0, self.config.health_ms / 1e3 * 4))
@@ -358,7 +550,8 @@ class RouterAPI:
     # ----------------------------------------------------------- membership
     def _poll_once(self, timeout: float = 2.0) -> None:
         for b in self.backends:
-            healthy, draining, gen, tenant_gens = b.probe(timeout=timeout)
+            healthy, draining, gen, tenant_gens, partition = b.probe(
+                timeout=timeout)
             with self._lock:
                 was = b.healthy
                 b.healthy = healthy
@@ -367,6 +560,12 @@ class RouterAPI:
                     b.generation = gen
                 if tenant_gens is not None:
                     b.tenant_generations = tenant_gens
+                if healthy:
+                    # a partition range is only trusted from a live 200
+                    # probe; an ejected replica keeps its last-known
+                    # range for the status page but the map rebuild
+                    # ignores it anyway (healthy+admitted only)
+                    b.partition = partition
             if healthy and not was:
                 journal.emit(
                     "router", f"backend {b.name} re-admitted "
@@ -385,6 +584,8 @@ class RouterAPI:
                     backend=b.name, draining=draining)
             self._m_backend_up.labels(backend=b.name).set(
                 1.0 if (healthy and b.admitted) else 0.0)
+        self._rebuild_pmap()
+        self._cache_sweep()
 
     def _poll_loop(self) -> None:
         interval = self.config.health_ms / 1e3
@@ -409,6 +610,165 @@ class RouterAPI:
                 "(forwarded request failed in transport)",
                 level=journal.RED, backend=b.name)
             self._m_backend_up.labels(backend=b.name).set(0.0)
+            self._rebuild_pmap()
+
+    # ------------------------------------------------------ partition map
+    def _rebuild_pmap(self) -> None:
+        """Recompute the partition map from current membership and swap
+        it in atomically.
+
+        A candidate map is one (count, generation) group of in-rotation
+        partition replicas; it is SERVABLE only when indices 0..count-1
+        are all covered AND every member reports the same scalar
+        generation — the two halves of the "mixed maps never co-serve
+        one query" contract (a re-partition or hot-swap becomes visible
+        only once its whole new map is up). Among servable candidates
+        the highest generation wins (the re-partition cutover). Queries
+        racing this rebuild hold a reference to the OLD snapshot — maps
+        are immutable once published."""
+        with self._lock:
+            part = [b for b in self.backends
+                    if b.healthy and b.admitted and b.partition]
+            old = self._pmap
+            if not part:
+                had_parts = any(b.partition for b in self.backends)
+                self._pmap = None
+                # partition replicas configured but none in rotation is
+                # a coverage gap, not a silent fall-back to full-model
+                # round-robin (there may be no full replica to fall to)
+                self._pmap_incomplete = had_parts
+            else:
+                groups: Dict[Tuple[int, Any], Dict[int, List[_Backend]]] = {}
+                for b in part:
+                    gkey = (b.partition["count"], b.generation)
+                    groups.setdefault(gkey, {}).setdefault(
+                        b.partition["index"], []).append(b)
+                best = None
+                for (count, gen), owners in groups.items():
+                    if set(owners) != set(range(count)):
+                        continue
+                    if best is None or (gen or 0) > (best[1] or 0):
+                        best = (count, gen, owners)
+                if best is None:
+                    self._pmap = None
+                    self._pmap_incomplete = True
+                else:
+                    count, gen, owners = best
+                    self._pmap = {
+                        "count": count,
+                        "generation": gen,
+                        "nItems": next(iter(owners.values()))[0]
+                        .partition["nItems"],
+                        "owners": {i: list(bs) for i, bs in owners.items()},
+                    }
+                    self._pmap_incomplete = False
+            new = self._pmap
+            incomplete = self._pmap_incomplete
+        if (new is None) != (old is None) or (
+                new is not None and old is not None
+                and (new["count"] != old["count"]
+                     or new["generation"] != old["generation"])):
+            if new is not None:
+                self._partition_width_gauge().set(float(new["count"]))
+                journal.emit(
+                    "router",
+                    f"partition map live: {new['count']} partition(s) "
+                    f"over {sum(len(v) for v in new['owners'].values())} "
+                    f"replica(s), generation {new['generation']}",
+                    level=journal.INFO, partitions=new["count"],
+                    generation=new["generation"])
+            else:
+                journal.emit(
+                    "router",
+                    "partition map LOST: coverage incomplete or "
+                    "generations mixed — partition queries answer 503 "
+                    "until a full map is back in rotation",
+                    level=journal.RED if incomplete else journal.INFO)
+
+    def _partition_metrics(self):
+        if self._m_partition_requests is None:
+            self._m_partition_requests = telemetry.registry().counter(
+                "pio_router_partition_requests_total",
+                "Partition-scattered /queries.json requests by outcome "
+                "(merged / coverage_gap / error / deadline)",
+                labelnames=("outcome",))
+        return self._m_partition_requests
+
+    def _partition_width_gauge(self):
+        if self._m_partition_width is None:
+            self._m_partition_width = telemetry.registry().gauge(
+                "pio_router_partition_width",
+                "Scatter width of the live partition map (how many "
+                "owning partitions one query fans out to); 0 = no map"
+            ).child()
+        return self._m_partition_width
+
+    # -------------------------------------------------------- cache plumbing
+    def _generation_token(self, tenant: str) -> Optional[Any]:
+        """The fleet-agreed model generation for ``tenant`` — the cache
+        key's invalidation component. Multi-tenant backends vote with
+        their per-tenant ``generations`` dict entry (the PR 16 fix: a
+        tenant's /reload must invalidate only ITS entries), legacy
+        backends with the scalar. No vote or a split vote (mid-barrier
+        skew) returns None — the cache stands aside rather than serve
+        either generation's answer for the other."""
+        votes = set()
+        with self._lock:
+            for b in self.backends:
+                if not (b.healthy and b.admitted):
+                    continue
+                if b.tenant_generations is not None:
+                    g = b.tenant_generations.get(tenant)
+                    if g is not None:
+                        votes.add(("t", g))
+                elif b.generation is not None:
+                    votes.add(("s", b.generation))
+        if len(votes) != 1:
+            return None
+        return next(iter(votes))
+
+    def _cache_sweep(self) -> None:
+        """Reclaim cache entries whose tenant's fleet generation moved
+        (they are unreachable already — generation is IN the key; this
+        frees their bytes now and journals the invalidation)."""
+        cache = self._cache
+        if cache is None:
+            return
+        tenants: set = {"-"}
+        with self._lock:
+            for b in self.backends:
+                tenants.update((b.tenant_generations or {}).keys())
+        for t in sorted(tenants):
+            token = self._generation_token(t)
+            if token is None:
+                continue
+            last = self._cache_gens.get(t)
+            self._cache_gens[t] = token
+            if last is not None and last != token:
+                dropped = cache.invalidate_tenant(t)
+                self._cache_metrics_update()
+                journal.emit(
+                    "router",
+                    f"response cache invalidated for tenant '{t}': "
+                    f"generation {last[1]} -> {token[1]} "
+                    f"({dropped} entries dropped)",
+                    level=journal.INFO, tenant=t, dropped=dropped)
+
+    def _cache_metrics_update(self) -> None:
+        """Sync the prom counters to the cache's own op counts (one
+        place, so TTL expiries inside get() and LRU evictions inside
+        put() are never under-reported)."""
+        cache = self._cache
+        if cache is None or self._m_cache_hits is None:
+            return
+        stats = cache.stats()
+        for metric, k in ((self._m_cache_hits, "hits"),
+                          (self._m_cache_misses, "misses"),
+                          (self._m_cache_evictions, "evictions")):
+            delta = stats[k] - metric.value
+            if delta > 0:
+                metric.inc(delta)
+        self._m_cache_ratio.set(stats["hitRatio"])
 
     def _eligible(self) -> List[_Backend]:
         with self._lock:
@@ -495,6 +855,38 @@ class RouterAPI:
                 n: sorted(v) for n, v in sorted(tenant_gens.items())}
             out["tenantGenerationSkew"] = sorted(
                 n for n, v in tenant_gens.items() if len(v) > 1)
+            # the PR 16 fix: under multi-tenancy the scalar generation
+            # legitimately differs per replica (it counts that PROCESS'S
+            # loads) — fleet skew is a per-tenant question, so the
+            # headline bool must follow the per-tenant verdict, not the
+            # scalar set
+            out["generationSkew"] = bool(out["tenantGenerationSkew"])
+        with self._lock:
+            pmap, incomplete = self._pmap, self._pmap_incomplete
+        if pmap is not None or incomplete or any(
+                b.get("partition") for b in backends):
+            # partition-routed fleets only (full fleets keep the exact
+            # PR 16 key set, wire parity asserted by test): the live
+            # map's owned ranges — what `pio doctor` summarizes and
+            # flags coverage gaps RED on
+            owners: Dict[str, List[Dict[str, Any]]] = {}
+            for b in backends:
+                p = b.get("partition")
+                if p and b["inRotation"]:
+                    owners.setdefault(str(p["index"]), []).append({
+                        "backend": b["url"], "lo": p["lo"], "hi": p["hi"]})
+            out["partitions"] = {
+                "complete": pmap is not None,
+                "count": (pmap or {}).get("count"),
+                "generation": (pmap or {}).get("generation"),
+                "nItems": (pmap or {}).get("nItems"),
+                "owners": {k: owners[k] for k in sorted(owners, key=int)},
+            }
+        cache = self._cache
+        if cache is not None:
+            # cache-enabled routers only (same parity rule): the stats
+            # the doctor's hit-ratio WARN reads
+            out["cache"] = {"enabled": True, **cache.stats()}
         return out
 
     def _readyz(self) -> Response:
@@ -547,6 +939,27 @@ class RouterAPI:
                 {"Retry-After": "1"}
         key = (query or {}).get("accessKey")
         tenant = self._tenant_label(key)
+        cache = self._cache
+        token = None
+        if cache is not None:
+            # front-door lookup BEFORE any admission charge: a hit
+            # touches no replica and must not consume inflight permits.
+            # token None = the fleet has no agreed generation for this
+            # tenant (empty rotation or mid-barrier skew) — stand aside
+            # rather than answer across a generation boundary.
+            token = self._generation_token(tenant)
+            if token is not None:
+                hit = cache.get((tenant, token, bytes(body)))
+                self._cache_metrics_update()
+                if hit is not None:
+                    with self._lock:
+                        self.request_count += 1
+                    if telemetry.on():
+                        self._m_requests.labels(outcome="ok",
+                                                tenant=tenant).inc()
+                        self._m_overhead.observe(
+                            max(time.perf_counter() - t_start, 0.0))
+                    return hit
         cap = self.config.tenant_max_inflight
         charged = False
         if key and cap > 0:
@@ -576,7 +989,24 @@ class RouterAPI:
                     "retry later")}, \
                     {"Retry-After": "1"}
             try:
-                return self._forward(body, headers, t_start, key=key)
+                with self._lock:
+                    pmap, pincomplete = self._pmap, self._pmap_incomplete
+                if pmap is not None or pincomplete:
+                    resp = self._scatter(pmap, body, headers, t_start)
+                else:
+                    resp = self._forward(body, headers, t_start, key=key)
+                if cache is not None and resp[0] == 200:
+                    # store under the POST-forward tenant label (the
+                    # forward may have just learned key→name) and a
+                    # freshly-agreed generation token
+                    label = self._tenant_label(key)
+                    store_token = self._generation_token(label)
+                    if store_token is not None:
+                        cache.put((label, store_token, bytes(body)),
+                                  resp[0], resp[1],
+                                  resp[2] if len(resp) > 2 else None)
+                        self._cache_metrics_update()
+                return resp
             finally:
                 self._inflight.release()
         finally:
@@ -695,6 +1125,196 @@ class RouterAPI:
             return self._respond(status, payload, rheaders, failed_over,
                                  t_start, backend_s, key=key)
 
+# --------------------------------------------------------- scatter/merge
+    def _ensure_scatter_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        with self._lock:
+            if self._scatter_pool is None:
+                self._scatter_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="pio-router-scatter")
+            return self._scatter_pool
+
+    def _scatter(self, pmap: Optional[Dict[str, Any]], body: bytes,
+                 headers: Dict[str, str], t_start: float) -> Response:
+        """Partition-routed dispatch: fan one query out to every owning
+        partition concurrently under the shared deadline budget, then
+        merge the per-partition top-k with serve_dist.merge_candidates —
+        the host twin of the device all-gather merge, so the answer is
+        bit-identical (values, indices, tie order) to one full-model
+        replica's. An incomplete map NEVER partial-merges: missing
+        coverage answers 503 outright."""
+        metrics = self._partition_metrics()
+        if pmap is None:
+            self._shed("partition coverage gap")
+            if telemetry.on():
+                metrics.labels(outcome="coverage_gap").inc()
+            return 503, {"message": (
+                "partition coverage is incomplete (no servable map); "
+                "retry later")}, {"Retry-After": "1"}
+        deadline = t_start + self._budget_s(headers)
+        self._partition_width_gauge().set(float(pmap["count"]))
+        fwd_headers = {"Content-Type": "application/json"}
+        ctx = tracing.current()
+        if ctx is not None:
+            fwd_headers[tracing.TRACE_HEADER] = ctx.header_value()
+
+        def leg(replicas: List[_Backend]) -> Tuple[str, Any, Any]:
+            """One partition's sub-request with intra-partition
+            failover: walk that partition's replicas (rr-rotated,
+            breaker-gated) until one answers; transport failures eject
+            (note_backend_failure → the map rebuilds without them)."""
+            start = next(self._rr)
+            last_err = "all replicas breaker-open"
+            for j in range(len(replicas)):
+                b = replicas[(start + j) % len(replicas)]
+                try:
+                    b.breaker.allow()
+                except resilience.CircuitOpenError:
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return "deadline", None, None
+                hdrs = {**fwd_headers,
+                        "X-PIO-Deadline-Ms": str(int(remaining * 1e3))}
+                try:
+                    with tracing.activate(ctx):
+                        if ctx is not None:
+                            with tracing.span("scatter", service=b.name):
+                                status, payload, _rh = b.request(
+                                    "POST", "/queries.json", body, hdrs,
+                                    timeout=remaining)
+                        else:
+                            status, payload, _rh = b.request(
+                                "POST", "/queries.json", body, hdrs,
+                                timeout=remaining)
+                except _TRANSPORT_ERRORS as e:
+                    b.breaker.record(False)
+                    self.note_backend_failure(b)
+                    last_err = f"{b.name}: {type(e).__name__}"
+                    continue
+                b.breaker.record(status < 500)
+                if status in (502, 503, 504):
+                    # per-partition failover: a draining/saturated
+                    # replica said "not me" — try its partition peers
+                    last_err = f"{b.name}: HTTP {status}"
+                    continue
+                return "ok", status, payload
+            return "exhausted", last_err, None
+
+        pool = self._ensure_scatter_pool()
+        owners = [pmap["owners"][i] for i in range(pmap["count"])]
+        t_fan = time.perf_counter()
+        futures = [pool.submit(leg, replicas) for replicas in owners]
+        results = []
+        try:
+            for f in futures:
+                results.append(f.result(
+                    timeout=max(deadline - time.perf_counter(), 0.001)))
+        except concurrent.futures.TimeoutError:
+            for f in futures:
+                f.cancel()
+            if telemetry.on():
+                metrics.labels(outcome="deadline").inc()
+                self._m_requests.labels(outcome="deadline",
+                                        tenant="-").inc()
+            return 504, {"message": "deadline exceeded"}
+        backend_s = time.perf_counter() - t_fan
+
+        def finish(outcome: str, resp: Response) -> Response:
+            with self._lock:
+                self.request_count += 1
+            if telemetry.on():
+                metrics.labels(outcome=outcome).inc()
+                self._m_requests.labels(
+                    outcome=("ok" if outcome == "merged"
+                             else "deadline" if outcome == "deadline"
+                             else "error"), tenant="-").inc()
+                self._m_overhead.observe(
+                    max(time.perf_counter() - t_start - backend_s, 0.0))
+            return resp
+
+        for verdict, a, payload in results:
+            if verdict == "deadline":
+                return finish("deadline",
+                              (504, {"message": "deadline exceeded"}))
+            if verdict == "exhausted":
+                # a whole partition went dark mid-flight — that is a
+                # coverage gap, and a gap never partial-merges
+                self._shed(f"partition leg failed ({a})")
+                return finish("coverage_gap", (
+                    503, {"message": (
+                        f"a partition became unavailable ({a}); "
+                        "retry later")}, {"Retry-After": "1"}))
+        parts = []
+        for verdict, status, payload in results:
+            try:
+                obj = json.loads(payload) if payload else {}
+            except ValueError:
+                return finish("error", (502, {
+                    "message": "backend returned a non-JSON reply"}))
+            if status != 200:
+                # every partition ran the same parse/validation on the
+                # same body — propagate the first non-200 verbatim
+                # (e.g. a 400 malformed query), exactly what one full
+                # replica would have answered
+                return finish("error" if status >= 500 else "merged",
+                              (status, obj))
+            parts.append(obj)
+        return finish("merged", self._merge(pmap, body, parts))
+
+    def _merge(self, pmap: Dict[str, Any], body: bytes,
+               parts: List[Dict[str, Any]]) -> Response:
+        """Reassemble the client-facing answer from per-partition 200s.
+
+        Each sub-response carries its candidates' GLOBAL item indices
+        (the replica's partition block); the two-key (value, lowest
+        global index) sort over the concatenated candidates is the same
+        rule the device all-gather merge applies, and the merged entry
+        dicts are the replicas' own parsed entries — Python's exact
+        float round-trip makes the re-serialized bytes identical to a
+        full replica's."""
+        from predictionio_tpu.parallel.serve_dist import merge_candidates
+        entries: List[Dict[str, Any]] = []
+        values: List[float] = []
+        gids: List[int] = []
+        degraded = False
+        n_items = None
+        for obj in parts:
+            block = obj.get("partition") if isinstance(obj, dict) else None
+            scores = (obj or {}).get("itemScores")
+            if (not isinstance(block, dict)
+                    or not isinstance(scores, list)
+                    or block.get("count") != pmap["count"]
+                    or len(block.get("itemIndices") or []) != len(scores)):
+                return 502, {"message": (
+                    "a partition replica answered without a consistent "
+                    "partition block (map raced a re-partition?); "
+                    "retry later")}, {"Retry-After": "1"}
+            if n_items is None:
+                n_items = int(block["nItems"])
+            elif n_items != int(block["nItems"]):
+                return 502, {"message": (
+                    "partition replicas disagree on the catalog size; "
+                    "retry later")}, {"Retry-After": "1"}
+            degraded = degraded or bool(obj.get("degraded"))
+            for entry, gid in zip(scores, block["itemIndices"]):
+                entries.append(entry)
+                values.append(float(entry.get("score", 0.0)))
+                gids.append(int(gid))
+        try:
+            num = int(json.loads(body).get("num", 0))
+        except (ValueError, TypeError, AttributeError):
+            num = 0
+        k = max(0, min(num, int(n_items or 0)))
+        if entries:
+            _v, _g, order = merge_candidates(values, gids, k)
+            merged = [entries[int(j)] for j in order]
+        else:
+            merged = []
+        out: Dict[str, Any] = {"itemScores": merged}
+        if degraded:
+            out["degraded"] = True
+        return 200, out
+
     def _respond(self, status: int, payload: bytes,
                  rheaders: Dict[str, str], failed_over: bool,
                  t_start: float, backend_s: float,
@@ -763,12 +1383,14 @@ class RouterAPI:
         deadline = time.perf_counter() + timeout_s
         old_tenant_gens = dict(b.tenant_generations or {})
         while time.perf_counter() < deadline:
-            healthy, _draining, gen, tenant_gens = b.probe()
+            healthy, _draining, gen, tenant_gens, partition = b.probe()
             with self._lock:
                 if gen is not None:
                     b.generation = gen
                 if tenant_gens is not None:
                     b.tenant_generations = tenant_gens
+                if healthy:
+                    b.partition = partition
                 b.healthy = healthy
             if healthy and gen is not None and (
                     old_gen is None or gen > old_gen):
@@ -797,6 +1419,9 @@ class RouterAPI:
         for b in backends:
             self._m_backend_up.labels(backend=b.name).set(
                 1.0 if (b.healthy and value) else 0.0)
+        # admission changes re-shape the partition map (the barrier's
+        # coordinated re-partition rides the same atomic map swap)
+        self._rebuild_pmap()
 
     def _reload_barrier(self) -> None:
         """The coordinated hot-swap: reload replicas one at a time while
@@ -885,6 +1510,7 @@ class RouterAPI:
         for b in flipped + [last]:
             self._m_backend_up.labels(backend=b.name).set(
                 1.0 if (b.healthy and b.admitted) else 0.0)
+        self._rebuild_pmap()
         journal.emit(
             "router", f"reload barrier cutover: {len(flipped)} backend(s) "
             f"now serving the new generation; reloading {last.name}",
@@ -928,6 +1554,9 @@ class RouterAPI:
 
     def close(self) -> None:
         self._stop_requested.set()
+        pool, self._scatter_pool = self._scatter_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         for b in self.backends:
             b.close()
 

@@ -314,6 +314,52 @@ def test_declarations_pass_fires_on_undeclared_tenant_metric():
         "metric-undeclared", "env-undeclared", "journal-undeclared")]
 
 
+def test_declarations_pass_covers_partition_and_cache_families():
+    """The partition-routing + response-cache subsystem is inside the
+    declarations triangle: a ghost cache metric and an undeclared
+    PIO_ROUTER_CACHE_* knob both fail the pass, while the real env
+    knobs and metric families registered by router/create_server
+    pass clean."""
+    bad_metric = (
+        "from predictionio_tpu.common import telemetry\n"
+        "c = telemetry.registry().counter(\n"
+        "    'pio_router_cache_ghost_total', 'x')\n")
+    found = [f for f in declarations.run(
+        [_mod(bad_metric, rel="predictionio_tpu/workflow/router.py")],
+        readme_text="") if f.rule == "metric-undeclared"]
+    assert len(found) == 1
+    assert "pio_router_cache_ghost_total" in found[0].message
+
+    bad_env = ("import os\n"
+               "x = os.environ.get('PIO_ROUTER_CACHE_GHOST_KNOB', '')\n")
+    found = [f for f in declarations.run(
+        [_mod(bad_env, rel="predictionio_tpu/workflow/router.py")],
+        readme_text="") if f.path != declarations._DECL_REL]
+    assert _rules(found) == ["env-undeclared"]
+
+    ok = ("import os\n"
+          "from predictionio_tpu.common import journal, telemetry\n"
+          "a = os.environ.get('PIO_ROUTER_CACHE', 'off')\n"
+          "b = os.environ.get('PIO_ROUTER_CACHE_MB', '16')\n"
+          "c = os.environ.get('PIO_ROUTER_CACHE_TTL_MS', '5000')\n"
+          "d = os.environ.get('PIO_DEPLOY_PARTITION', '')\n"
+          "reg = telemetry.registry()\n"
+          "reg.counter('pio_router_cache_hits_total', 'x')\n"
+          "reg.counter('pio_router_cache_misses_total', 'x')\n"
+          "reg.counter('pio_router_cache_evictions_total', 'x')\n"
+          "reg.gauge('pio_router_cache_hit_ratio', 'x')\n"
+          "reg.counter('pio_router_partition_requests_total', 'x',\n"
+          "            labelnames=('outcome',))\n"
+          "reg.gauge('pio_router_partition_width', 'x')\n"
+          "journal.emit('router', 'partition map live',\n"
+          "             level=journal.INFO)\n")
+    found = declarations.run(
+        [_mod(ok, rel="predictionio_tpu/workflow/router.py")],
+        readme_text="")
+    assert not [f for f in found if f.rule in (
+        "metric-undeclared", "env-undeclared", "journal-undeclared")]
+
+
 def test_declarations_pass_fires_on_undeclared_category_in_realtime():
     """The new realtime subsystem is inside the journal-undeclared
     scope like everything else: a fold-in emitter with a typo'd

@@ -1,0 +1,397 @@
+"""Partition-routed serving (`pio deploy --partition i/N` +
+workflow/router.py scatter/merge).
+
+The contracts under test:
+
+- `parse_partition` / `partition_rows`: the contiguous, order-
+  preserving row split — slices tile [0, n) exactly, sizes within 1;
+- `merge_candidates` is the HOST twin of the device all-gather merge:
+  bit-identical values/indices to ``lax.sort((-v, g), num_keys=2)``
+  for every k, cross-partition ties included (lowest global index
+  wins), and merging per-partition top-k candidate lists reproduces
+  the global top-k (the coverage guarantee the scatter relies on);
+- a partition replica advertises its owned range on /readyz and
+  annotates answers with global item indices; the router assembles a
+  servable map and a partition fleet's merged answers over live HTTP
+  are BYTE-identical to a single full-model replica — including the
+  naturally-tied scores that straddle the partition boundary;
+- coverage incomplete (one partition ejected) => 503 + Retry-After,
+  never a partial merge, and the map loss is journaled RED;
+- the default config (no --partition, cache off) advertises nothing
+  new: GET / carries neither `partitions` nor `cache`, and routed
+  bytes equal the replica's own — the PR 16 wire, untouched;
+- `--partition` refuses to compose with `--engines` multi-tenancy;
+- `pio doctor` turns a coverage gap RED and a cold enabled cache WARN
+  from the scraped surfaces alone.
+"""
+
+import datetime as dt
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.common import journal
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.data.api.http import make_server, serve_background
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+)
+from predictionio_tpu.parallel.serve_dist import (
+    merge_candidates, parse_partition, partition_rows,
+)
+from predictionio_tpu.workflow import WorkflowContext, run_train
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
+
+UTC = dt.timezone.utc
+FACTORY = "predictionio_tpu.models.recommendation:RecommendationEngine"
+
+
+# ---------------------------------------------------------------------------
+# the row split + the host merge twin (no fleet needed)
+# ---------------------------------------------------------------------------
+
+def test_parse_partition():
+    assert parse_partition("0/2") == (0, 2)
+    assert parse_partition("3/4") == (3, 4)
+    assert parse_partition(" 1/2 ") == (1, 2)
+    for bad in ("", "2/2", "4/3", "-1/2", "0/0", "0/-1", "a/b", "1",
+                "1/2/3", "1.5/2"):
+        with pytest.raises(ValueError):
+            parse_partition(bad)
+
+
+def test_partition_rows_tile_exactly():
+    for n in (0, 1, 5, 6, 7, 64, 1000):
+        for count in (1, 2, 3, 5, 8):
+            slices = [partition_rows(n, i, count) for i in range(count)]
+            # contiguous, order-preserving, tiling [0, n) exactly
+            assert slices[0][0] == 0 and slices[-1][1] == n
+            for (alo, ahi), (blo, bhi) in zip(slices, slices[1:]):
+                assert ahi == blo
+            sizes = [hi - lo for lo, hi in slices]
+            assert sum(sizes) == n
+            assert max(sizes) - min(sizes) <= 1
+
+
+def test_merge_candidates_bit_parity_with_device_sort():
+    """The host merge must land on EXACTLY the device rule: two-key
+    sort, score descending then lowest global index — values
+    bit-identical, ties (planted across the would-be partition
+    boundary) resolved identically."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    v = rng.standard_normal(40).astype(np.float32)
+    # cross-partition ties: equal float32 scores at far-apart gids
+    v[3] = v[29] = np.float32(1.5)
+    v[7] = v[21] = v[33] = np.float32(0.25)
+    g = np.arange(40, dtype=np.int32)
+    neg, sid = lax.sort((-jnp.asarray(v), jnp.asarray(g)),
+                        num_keys=2, dimension=-1)
+    dev_v, dev_g = -np.asarray(neg), np.asarray(sid)
+    for k in (1, 2, 5, 17, 40):
+        mv, mg, order = merge_candidates(v, g, k)
+        assert mv.tobytes() == dev_v[:k].tobytes()
+        assert np.array_equal(mg, dev_g[:k])
+        assert len(order) == k
+    # the tie rule, spelled out: among equal scores the LOWEST global
+    # index comes first (both planted groups)
+    mv, mg, _ = merge_candidates(v, g, 40)
+    for tied in (np.int32(3), np.int32(7)):
+        group = mg[mv == v[tied]]
+        assert list(group) == sorted(group)
+
+
+def test_merge_of_per_partition_topk_equals_global_topk():
+    """The coverage guarantee: each partition contributing its LOCAL
+    top-k (same two-key rule) is enough — merging the candidate lists
+    reproduces the global top-k bit for bit. This is exactly what the
+    router does with N replicas' answers."""
+    rng = np.random.default_rng(7)
+    n, k = 101, 10
+    v = rng.standard_normal(n).astype(np.float32)
+    v[4] = v[77] = np.float32(2.25)        # a tie straddling partitions
+    g = np.arange(n, dtype=np.int32)
+    want_v, want_g, _ = merge_candidates(v, g, k)
+    for count in (2, 3, 5):
+        cand_v, cand_g = [], []
+        for i in range(count):
+            lo, hi = partition_rows(n, i, count)
+            lv, lg, _ = merge_candidates(v[lo:hi], g[lo:hi], k)
+            cand_v.append(lv)
+            cand_g.append(lg)
+        got_v, got_g, _ = merge_candidates(
+            np.concatenate(cand_v), np.concatenate(cand_g), k)
+        assert got_v.tobytes() == want_v.tobytes(), count
+        assert np.array_equal(got_g, want_g), count
+
+
+def test_partition_refuses_multitenancy(memory_storage):
+    with pytest.raises(ValueError):
+        QueryAPI(storage=memory_storage,
+                 config=ServerConfig(partition="0/2",
+                                     tenants=("shop",)))
+
+
+# ---------------------------------------------------------------------------
+# the live fleet: byte parity, coverage gap, default-config wire parity
+# ---------------------------------------------------------------------------
+
+def _train_seeded(storage, app_name="PartitionApp", seed=3):
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, app_name, None))
+    storage.get_events().init(app_id)
+    events = []
+    for u in range(8):
+        for i in range(6):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": 5.0 if (u % 2) == (i % 2) else 1.0}),
+                event_time=dt.datetime(2021, 1, 1, 0,
+                                       (u * 6 + i) % 60, tzinfo=UTC)))
+    storage.get_events().insert_batch(events, app_id)
+    engine = RecommendationEngine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(appName=app_name),
+        algorithm_params_list=(
+            ("als", ALSAlgorithmParams(rank=4, numIterations=3,
+                                       lambda_=0.05, seed=seed)),))
+    run_train(WorkflowContext(storage=storage), engine, ep,
+              engine_factory=FACTORY,
+              params_json={
+                  "datasource": {"params": {"appName": app_name}},
+                  "algorithms": [{"name": "als", "params": {
+                      "rank": 4, "numIterations": 3, "lambda": 0.05,
+                      "seed": seed}}]})
+    return engine
+
+
+def _replica(storage, engine, partition=""):
+    api = QueryAPI(storage=storage, engine=engine,
+                   config=ServerConfig(batching="on", aot="off",
+                                       partition=partition))
+    server = make_server(api, "127.0.0.1", 0, transport="async")
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return api, server, server.server_address[1]
+
+
+def _raw_query(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    try:
+        conn.request("POST", "/queries.json", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), {k.lower(): v for k, v
+                                          in resp.getheaders()}
+    finally:
+        conn.close()
+
+
+def test_partition_fleet_wire_byte_identical(memory_storage):
+    """THE tentpole contract over live HTTP: one full replica vs a
+    router over two partition replicas of the SAME trained model —
+    every (user, num) answer byte-identical, the parity-patterned
+    data guaranteeing tied scores that straddle the partition
+    boundary; then a killed partition turns the fleet into a clean
+    503 coverage gap, never a partial merge."""
+    journal.clear()
+    engine = _train_seeded(memory_storage)
+    api_full, s_full, p_full = _replica(memory_storage, engine)
+    api0, s0, p0 = _replica(memory_storage, engine, partition="0/2")
+    api1, s1, p1 = _replica(memory_storage, engine, partition="1/2")
+    router = RouterAPI(RouterConfig(
+        backends=(f"http://127.0.0.1:{p0}", f"http://127.0.0.1:{p1}"),
+        health_ms=80.0))
+    rserver, rport = serve_background(router)
+    try:
+        # replicas advertise the owned range on /readyz
+        conn = http.client.HTTPConnection("127.0.0.1", p0)
+        conn.request("GET", "/readyz")
+        ready = json.loads(conn.getresponse().read())
+        conn.close()
+        assert ready["partition"]["index"] == 0
+        assert ready["partition"]["count"] == 2
+        assert ready["partition"]["nItems"] == 6
+        boundary = ready["partition"]["hi"]
+
+        # the router assembles a complete servable map
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and router._pmap is None:
+            time.sleep(0.05)
+        st = router.handle("GET", "/")[1]
+        parts = st["partitions"]
+        assert parts["complete"] and parts["count"] == 2, parts
+        assert set(parts["owners"]) == {"0", "1"}
+
+        # byte parity on EVERY user at several k, including k > rows
+        # per partition and k > the whole catalog
+        mismatches = []
+        for u in range(8):
+            for num in (1, 3, 6, 10):
+                body = json.dumps({"user": f"u{u}", "num": num})
+                full = _raw_query(p_full, body)
+                routed = _raw_query(rport, body)
+                if full[:2] != routed[:2]:
+                    mismatches.append((u, num, full[0], routed[0]))
+        assert not mismatches, mismatches
+
+        # the parity data really does tie ACROSS the boundary: the
+        # full answer at num=6 has equal scores on both sides
+        payload = json.loads(_raw_query(
+            p_full, json.dumps({"user": "u1", "num": 6}))[1])
+        scores = [(s["score"], int(s["item"][1:]))
+                  for s in payload["itemScores"]]
+        straddles = any(
+            sa == sb and (ia < boundary) != (ib < boundary)
+            for x, (sa, ia) in enumerate(scores)
+            for sb, ib in scores[x + 1:])
+        assert straddles, scores
+
+        # merged answers never leak the replica-side partition block
+        assert b'"partition"' not in _raw_query(
+            rport, json.dumps({"user": "u1", "num": 3}))[1]
+
+        # a malformed body propagates the replica's own error verbatim
+        assert _raw_query(rport, b'{"num": 1}')[0] == \
+            _raw_query(p_full, b'{"num": 1}')[0]
+
+        # kill one partition: the map is LOST (journaled RED) and the
+        # fleet answers 503 + Retry-After — never a 1-partition merge
+        s1.shutdown()
+        api1.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and router._pmap is not None:
+            time.sleep(0.05)
+        assert router._pmap is None
+        status, payload, headers = _raw_query(
+            rport, json.dumps({"user": "u1", "num": 3}))
+        assert status == 503, payload
+        assert b"coverage" in payload
+        assert headers["retry-after"]
+        st = router.handle("GET", "/")[1]
+        assert st["partitions"]["complete"] is False
+        ev = journal.snapshot(category="router")
+        assert any("partition map LOST" in e["message"]
+                   and e["level"] == "red" for e in ev["events"]), \
+            [e["message"] for e in ev["events"]]
+        assert any("partition map live" in e["message"]
+                   for e in ev["events"])
+    finally:
+        rserver.shutdown()
+        router.close()
+        s_full.shutdown()
+        api_full.close()
+        s0.shutdown()
+        api0.close()
+        s1.shutdown()
+        api1.close()
+
+
+def test_default_config_wire_unchanged(memory_storage):
+    """No --partition, cache off: the router advertises neither
+    `partitions` nor `cache` on GET / and routed bytes equal the
+    replica's own — the pre-partition wire, byte for byte."""
+    engine = _train_seeded(memory_storage, app_name="PlainApp")
+    api, server, port = _replica(memory_storage, engine)
+    router = RouterAPI(RouterConfig(
+        backends=(f"http://127.0.0.1:{port}",), health_ms=80.0))
+    rserver, rport = serve_background(router)
+    try:
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and router.handle("GET", "/")[1]["inRotation"] != 1):
+            time.sleep(0.02)
+        st = router.handle("GET", "/")[1]
+        assert "partitions" not in st
+        assert "cache" not in st
+        body = json.dumps({"user": "u1", "num": 4})
+        assert _raw_query(rport, body)[:2] == _raw_query(port, body)[:2]
+    finally:
+        rserver.shutdown()
+        router.close()
+        server.shutdown()
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor: coverage gap RED, cold cache WARN (constructed scrapes)
+# ---------------------------------------------------------------------------
+
+def _router_scrape(root_extra):
+    root = {"status": "alive", "router": True,
+            "backends": [{"url": "http://a:1", "inRotation": True,
+                          "generation": 1, "breaker": "closed"}],
+            "generations": [1], "generationSkew": False,
+            "shedCount": 0, **root_extra}
+    return {
+        "url": "http://t",
+        "healthz": {"status": 200, "body": '{"status": "ok"}'},
+        "readyz": {"status": 200, "body": '{"status": "ready"}'},
+        "root": {"status": 200, "body": json.dumps(root)},
+        "metrics": {"status": 200, "body": ""},
+        "traces": {"status": 200, "body": '{"spanCount": 0}'},
+        "device": {"status": 200, "body": '{"telemetry": false}'},
+        "slow": {"status": 200, "body": '{"enabled": false}'},
+        "events": {"status": 200,
+                   "body": '{"enabled": true, "events": []}'},
+    }
+
+
+def test_doctor_partition_coverage_gap_is_red():
+    from predictionio_tpu.tools.doctor import diagnose
+
+    scraped = _router_scrape({"partitions": {
+        "complete": False, "count": None, "generation": None,
+        "nItems": None, "owners": {"0": [
+            {"backend": "http://a:1", "lo": 0, "hi": 3}]}}})
+    checks = {c: (s, d) for c, s, d in diagnose(scraped)}
+    state, detail = checks["router"]
+    assert state == "RED" and "COVERAGE GAP" in detail
+    assert "503" in detail
+
+
+def test_doctor_partition_map_rides_ok_detail():
+    from predictionio_tpu.tools.doctor import diagnose
+
+    scraped = _router_scrape({"partitions": {
+        "complete": True, "count": 2, "generation": 3, "nItems": 6,
+        "owners": {"0": [{"backend": "http://a:1", "lo": 0, "hi": 3}],
+                   "1": [{"backend": "http://b:2", "lo": 3, "hi": 6}]}}})
+    checks = {c: (s, d) for c, s, d in diagnose(scraped)}
+    state, detail = checks["router"]
+    assert state == "ok", detail
+    assert "partition map 2 wide" in detail
+    assert "p0=[0,3)x1" in detail and "p1=[3,6)x1" in detail
+
+
+def test_doctor_cold_enabled_cache_warns():
+    from predictionio_tpu.tools.doctor import diagnose
+
+    cold = _router_scrape({"cache": {
+        "enabled": True, "entries": 40, "bytes": 1000,
+        "maxBytes": 1 << 20, "ttlMs": 5000.0,
+        "hits": 0, "misses": 40, "evictions": 0, "hitRatio": 0.0}})
+    checks = {c: (s, d) for c, s, d in diagnose(cold)}
+    state, detail = checks["router"]
+    assert state == "WARN" and "cache" in detail
+    assert "0.0%" in detail
+    # a warm cache (or one without traffic yet) stays OK
+    for stats in ({"hits": 30, "misses": 10, "hitRatio": 0.75},
+                  {"hits": 0, "misses": 3, "hitRatio": 0.0}):
+        warm = _router_scrape({"cache": {
+            "enabled": True, "entries": 5, "bytes": 100,
+            "maxBytes": 1 << 20, "ttlMs": 5000.0, "evictions": 0,
+            **stats}})
+        checks = {c: (s, d) for c, s, d in diagnose(warm)}
+        assert checks["router"][0] == "ok", checks["router"]
