@@ -2329,6 +2329,193 @@ def measure_router_cache(n_conns: int = 6, queries_per_client: int = 80,
     return out
 
 
+def measure_autopilot(n_conns: int = 4, queries_per_client: int = 200,
+                      exponent: float = 1.1):
+    """Autopilot leg (workflow/autopilot.py): two chapters against a
+    real subprocess fleet.
+
+    **Chaos recovery** — a replica process is SIGKILLed mid-way through
+    a zipfian client burst (the same ``query_keys`` stream as the cache
+    leg) with the autopilot live; the leg measures the seconds until
+    the fleet is back at full rotation with the corpse retired and a
+    pool-spawned replacement serving, and asserts the burst saw zero
+    client failures (the router's failover + the autopilot's refill
+    together). The recovery-time gate is enforced on >= 4-core hosts
+    under BENCH_STRICT_EXTRAS=1 (``autopilot_gate_capable`` records the
+    honest skip — a replica subprocess cold-starts jax on one shared
+    core otherwise).
+
+    **Burn ladder** — with shrunk SLO windows, a synthetic error burst
+    pushes BOTH burn windows over the 14.4x page threshold through the
+    REAL signal path (registry exposition -> gather -> tick): the
+    ladder must widen the router's shed thresholds, and after a clean
+    stretch restore the EXACT prior values (gated everywhere — it is
+    in-process arithmetic, not a timing race)."""
+    from predictionio_tpu.common import journal, slo, telemetry
+    from predictionio_tpu.data.api.http import serve_background
+    from predictionio_tpu.data.synthetic import query_keys
+    from predictionio_tpu.workflow.autopilot import (
+        Autopilot, AutopilotConfig, LocalRouterControl, ReplicaPool,
+    )
+    from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    capable = cores >= 4
+    fleet = _RouterFleet("pio_autopilot_")
+    out: dict = {"autopilot_gate_capable": capable}
+    keys = query_keys(n_conns * queries_per_client, seed=7,
+                      exponent=exponent, pool=64)
+
+    def body_fn(cx, q):
+        return json.dumps(
+            {"user": f"u{int(keys[cx * queries_per_client + q])}",
+             "num": 10}).encode()
+
+    class _FleetPool(ReplicaPool):
+        """The ReplicaPool hook backed by the bench fleet's replica
+        subprocesses (what `pio autopilot --replica-cmd` does with
+        shell commands)."""
+
+        def __init__(self):
+            self.procs: dict = {}
+            self.spawns = 0
+
+        def spawn(self):
+            self.spawns += 1
+            port = fleet.free_port()
+            proc = fleet.spawn_replica(port)
+            if not fleet.wait_ready(port):
+                proc.kill()
+                return None
+            url = f"http://127.0.0.1:{port}"
+            self.procs[url] = proc
+            return url
+
+        def stop(self, url):
+            proc = self.procs.pop(url, None)
+            if proc is None:
+                return False
+            proc.kill()
+            return True
+
+        def close(self):
+            for proc in self.procs.values():
+                proc.kill()
+
+    import threading as _threading
+    try:
+        ports = [fleet.free_port() for _ in range(2)]
+        procs = [fleet.spawn_replica(p) for p in ports]
+        for p in ports:
+            if not fleet.wait_ready(p):
+                raise RuntimeError(f"replica on port {p} never ready")
+        router = RouterAPI(RouterConfig(
+            backends=tuple(f"http://127.0.0.1:{p}" for p in ports),
+            health_ms=100.0))
+        rserver, rport = serve_background(router)
+        pool = _FleetPool()
+        ap = Autopilot(LocalRouterControl(router),
+                       config=AutopilotConfig(
+                           poll_ms=100.0, cooldown_s=1.0,
+                           min_replicas=2, max_replicas=3),
+                       pool=pool)
+        loop = _threading.Thread(target=ap.run, daemon=True)
+        loop.start()
+        try:
+            # ---- chaos recovery: kill one replica mid-burst ----------
+            pump_errors: list = []
+
+            def burst():
+                try:
+                    fleet.pump(rport, n_conns, queries_per_client,
+                               body_fn)
+                except Exception as e:
+                    pump_errors.append(f"{type(e).__name__}: {e}")
+
+            pump_thread = _threading.Thread(target=burst)
+            pump_thread.start()
+            time.sleep(0.4)
+            procs[0].kill()                      # the chaos event
+            t_kill = time.perf_counter()
+            dead_url = f"http://127.0.0.1:{ports[0]}"
+            recovery_s = None
+            deadline = time.perf_counter() + 180.0
+            while time.perf_counter() < deadline:
+                st = router.handle("GET", "/")[1]
+                urls = {b["url"] for b in st["backends"]}
+                if (st["inRotation"] >= 2 and dead_url not in urls
+                        and all(b["inRotation"]
+                                for b in st["backends"])):
+                    recovery_s = round(time.perf_counter() - t_kill, 2)
+                    break
+                time.sleep(0.2)
+            pump_thread.join(timeout=120.0)
+            out["autopilot_recovery_s"] = recovery_s
+            out["autopilot_replicas_spawned"] = pool.spawns
+            out["autopilot_zero_failures"] = not pump_errors
+            if pump_errors:
+                out["autopilot_burst_error"] = pump_errors[0]
+            ev = journal.snapshot(category="autopilot")["events"]
+            out["autopilot_journaled_events"] = len(ev)
+        finally:
+            ap.stop()
+            loop.join(timeout=10.0)
+
+        # ---- burn ladder: widen on a real page, restore exactly ------
+        telemetry.set_enabled(True)
+        slo.reset()
+        slo.install(slo.SLOConfig(availability=0.999,
+                                  fast_window_s=1.0, slow_window_s=2.0))
+        try:
+            c = telemetry.registry().counter(
+                "pio_http_requests_total",
+                "HTTP requests by service and status",
+                labelnames=("service", "status"))
+            base = router.set_shed_thresholds()
+            ap2 = Autopilot(LocalRouterControl(router),
+                            config=AutopilotConfig(poll_ms=100.0,
+                                                   cooldown_s=0.5))
+            c.labels(service="AutopilotBench", status="200").inc(1000)
+            ap2.gather()                 # baseline scrape + SLO snapshot
+            time.sleep(0.2)
+            c.labels(service="AutopilotBench", status="500").inc(100)
+            c.labels(service="AutopilotBench", status="200").inc(900)
+            time.sleep(0.2)
+            acted = ap2.tick(ap2.gather())
+            widened = any(a["action"] == "shed_widen" for a in acted)
+            mid = router.set_shed_thresholds()
+            c.labels(service="AutopilotBench", status="200").inc(5000)
+            restored = False
+            deadline = time.perf_counter() + 20.0
+            while time.perf_counter() < deadline:
+                time.sleep(0.4)
+                ap2.tick(ap2.gather())
+                if (router.set_shed_thresholds() == base
+                        and ap2.summary()["ladderDepth"] == 0):
+                    restored = True
+                    break
+            out["autopilot_ladder_widened"] = bool(widened
+                                                   and mid != base)
+            out["autopilot_ladder_restored"] = bool(restored)
+            out["autopilot_ladder_ok"] = bool(
+                out["autopilot_ladder_widened"] and restored)
+            out["autopilot_actions_total"] = (
+                ap.summary()["actionsTotal"]
+                + ap2.summary()["actionsTotal"])
+        finally:
+            telemetry.set_enabled(None)
+            slo.reset()
+        rserver.shutdown()
+        router.close()
+        pool.close()
+    finally:
+        fleet.close()
+    return out
+
+
 def measure_multitenant(n_conns: int = 6, queries_per_client: int = 50,
                         flood_threads: int = 4):
     """Multi-tenant serving leg (serving/registry.py + the --engines
@@ -3154,6 +3341,19 @@ def main() -> None:
                 cache_leg = {"router_cache_error":
                              f"{type(e).__name__}: {e}"}
 
+        # autopilot leg (workflow/autopilot.py): a replica SIGKILL under
+        # a zipfian burst with the control loop live — recovery seconds
+        # back to full rotation (strict on >= 4-core hosts;
+        # autopilot_gate_capable records the honest skip) plus the
+        # burn-ladder widen + exact-restore cycle (strict everywhere)
+        autopilot_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                autopilot_leg = measure_autopilot()
+            except Exception as e:
+                autopilot_leg = {"autopilot_error":
+                                 f"{type(e).__name__}: {e}"}
+
         # multi-tenant leg (serving/registry.py): one process, N engine
         # instances — shared-AOT compile flatness (strict everywhere)
         # and noisy-neighbor p99 isolation (strict on >= 4-core hosts;
@@ -3326,6 +3526,7 @@ def main() -> None:
                 **(router_leg or {}),
                 **(partition_leg or {}),
                 **(cache_leg or {}),
+                **(autopilot_leg or {}),
                 **(mt_leg or {}),
                 **(recompile_watch or {}),
                 **(stream_leg or {}),
@@ -3616,6 +3817,38 @@ def main() -> None:
                         "did not beat uncached p99 "
                         f"({cache_leg.get('router_uncached_p99_ms')} ms)"
                         " with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and autopilot_leg:
+            if autopilot_leg.get("autopilot_error"):
+                failures.append(
+                    "autopilot leg crashed "
+                    f"({autopilot_leg['autopilot_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                # the ladder is in-process arithmetic: widen + exact
+                # restore must hold on any host
+                if not autopilot_leg.get("autopilot_ladder_ok"):
+                    failures.append(
+                        "autopilot burn ladder did not widen and "
+                        "exactly restore (widened="
+                        f"{autopilot_leg.get('autopilot_ladder_widened')}"
+                        ", restored="
+                        f"{autopilot_leg.get('autopilot_ladder_restored')}"
+                        ") with BENCH_STRICT_EXTRAS=1")
+                # recovery timing + zero-failure burst only where a
+                # replica subprocess can cold-start off the burst's CPUs
+                if autopilot_leg.get("autopilot_gate_capable"):
+                    rec = autopilot_leg.get("autopilot_recovery_s")
+                    if rec is None or rec > 120.0:
+                        failures.append(
+                            "autopilot did not recover the fleet "
+                            f"within 120 s (recovery_s={rec}) after a "
+                            "replica kill with BENCH_STRICT_EXTRAS=1")
+                    if not autopilot_leg.get("autopilot_zero_failures"):
+                        failures.append(
+                            "client burst saw failures during the "
+                            "autopilot chaos leg ("
+                            f"{autopilot_leg.get('autopilot_burst_error')}"
+                            ") with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and mt_leg:
             if mt_leg.get("multitenant_error"):
                 failures.append(

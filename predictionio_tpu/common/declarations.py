@@ -355,6 +355,29 @@ ENV_VARS: Dict[str, str] = {
         "fast burn-rate window (default 300)",
     "PIO_SLO_SLOW_WINDOW_S":
         "slow burn-rate window (default 3600)",
+    # ----------------------------------------------------------- autopilot
+    "PIO_AUTOPILOT_POLL_MS":
+        "autopilot control-loop cadence in ms (default 1000)",
+    "PIO_AUTOPILOT_COOLDOWN_S":
+        "per-action-class rate limit: one scale / shed / quarantine / "
+        "profile action per class per this many seconds (default 30)",
+    "PIO_AUTOPILOT_UTIL_LOW":
+        "fleet busy-fraction floor below which the autopilot drains a "
+        "replica (default 0.2)",
+    "PIO_AUTOPILOT_UTIL_HIGH":
+        "fleet busy-fraction ceiling above which the autopilot spawns "
+        "a replica (default 0.85)",
+    "PIO_AUTOPILOT_MIN_REPLICAS":
+        "rotation floor the autopilot refills to after a replica dies, "
+        "and the scale-down floor (default 1)",
+    "PIO_AUTOPILOT_MAX_REPLICAS":
+        "rotation ceiling for utilization-driven spawns (default 4)",
+    "PIO_AUTOPILOT_OUTLIER_X":
+        "quarantine trigger: a backend whose query-latency p99 exceeds "
+        "this multiple of the fleet median is held out (default 3)",
+    "PIO_AUTOPILOT_PROFILE_MS":
+        "length of the one profile capture the autopilot triggers per "
+        "sustained-burn episode (default 2000)",
 }
 
 #: every pio_* metric family / collector-emitted series -> one-liner.
@@ -452,6 +475,21 @@ METRICS: Dict[str, str] = {
     "pio_router_partition_width":
         "scatter width of the live partition map (how many owning "
         "partitions one query fans out to); 0 = no map",
+    "pio_router_backend_seconds":
+        "backend call time per forwarded attempt, labeled by backend — "
+        "the per-replica latency signal the autopilot's outlier "
+        "quarantine reads",
+    # ----------------------------------------------------------- autopilot
+    "pio_autopilot_actions_total":
+        "autopilot actions by action (scale_up / scale_down / "
+        "shed_widen / shed_narrow / quarantine / readmit / "
+        "profile_capture) and outcome (ok / failed / dry_run)",
+    "pio_autopilot_state":
+        "degradation-ladder depth (0 = normal thresholds); -1 while "
+        "the loop holds off under generation skew or a reload barrier",
+    "pio_autopilot_last_action_age_seconds":
+        "seconds since the autopilot's most recent (or dry-run "
+        "would-have) action; 0 until the first",
     # ----------------------------------------------------------- transport
     "pio_http_requests_total": "HTTP requests by path/code",
     "pio_http_request_seconds": "HTTP request handling latency",
@@ -566,6 +604,12 @@ JOURNAL_CATEGORIES: Dict[str, str] = {
         "a generation, over-budget install (warn), hard-cap refusal, "
         "access key unmapped to any tenant (warn) "
         "(serving/registry.py, workflow/create_server.py)",
+    "autopilot":
+        "SLO-driven control-loop decisions with their triggering "
+        "evidence: scale up/down, shed widen/narrow (the degradation "
+        "ladder), quarantine/readmit, profile captures, hold-offs "
+        "under generation skew, and dry-run would-have actions "
+        "(workflow/autopilot.py)",
 }
 
 

@@ -159,6 +159,14 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # into the streamed path) are visible round over round
     ("train_stream_ratings_per_s", "up", False),
     ("train_stream_peak_rss_mb", "down", False),
+    # autopilot era (workflow/autopilot.py): seconds from a replica
+    # SIGKILL to the fleet back at full rotation with the corpse
+    # retired (the self-healing promise, strict-gated at <= 120 s on
+    # capable hosts by the bench leg itself), and the total actions the
+    # leg's control loops took — a creeping rise means the loop is
+    # flapping where it used to converge
+    ("autopilot_recovery_s", "down", False),
+    ("autopilot_actions_total", "down", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round

@@ -403,9 +403,47 @@ def cmd_router(args) -> int:
         cache_mb=getattr(args, "cache_mb", 0) or 0,
         cache_ttl_ms=getattr(args, "cache_ttl_ms", 0.0) or 0.0)
     api = RouterAPI(config)
+    ap = None
+    if getattr(args, "autopilot", False):
+        # embedded autopilot: the control loop rides the router process
+        # and steers it through direct method calls (no HTTP hop)
+        import threading
+
+        from predictionio_tpu.workflow.autopilot import (
+            Autopilot, AutopilotConfig, LocalRouterControl,
+            SubprocessReplicaPool,
+        )
+        pool = None
+        if getattr(args, "replica_cmd", ""):
+            pool = SubprocessReplicaPool(args.replica_cmd)
+        ap = Autopilot(
+            LocalRouterControl(api),
+            config=AutopilotConfig(
+                dry_run=getattr(args, "autopilot_dry_run", False)),
+            pool=pool)
+        api.attach_autopilot(ap)
+        threading.Thread(target=ap.run, name="pio-autopilot",
+                         daemon=True).start()
+        _info("Autopilot is "
+              + ("DRY-RUN (journals would-have decisions only)."
+                 if ap.config.dry_run else "live."))
     _info(f"Router is live at http://{args.ip}:{args.port} over "
           f"{len(api.backends)} backend(s).")
-    serve(api, host=args.ip, port=args.port)
+    try:
+        serve(api, host=args.ip, port=args.port)
+    finally:
+        if ap is not None:
+            ap.close()
+    return 0
+
+
+def cmd_autopilot(args) -> int:
+    """SLO-driven fleet control loop (workflow/autopilot.py) over a
+    running router's admin routes."""
+    from predictionio_tpu.workflow.autopilot import run_autopilot
+    _apply_telemetry_env(args)
+    run_autopilot(args.router, dry_run=args.dry_run,
+                  replica_cmd=args.replica_cmd)
     return 0
 
 
@@ -976,6 +1014,34 @@ def build_parser() -> argparse.ArgumentParser:
                     help="response-cache entry TTL in ms — bounds "
                          "fold-in staleness, KNOWN_ISSUES #17 (0 = "
                          "PIO_ROUTER_CACHE_TTL_MS or 5000)")
+    sp.add_argument("--autopilot", action="store_true",
+                    help="embed the SLO-driven control loop in this "
+                         "router process (workflow/autopilot.py)")
+    sp.add_argument("--autopilot-dry-run", action="store_true",
+                    help="embedded autopilot journals would-have "
+                         "decisions without acting")
+    sp.add_argument("--replica-cmd", default="",
+                    help="shell command template (with a {port} "
+                         "placeholder) the autopilot spawns local "
+                         "replica subprocesses from; empty disables "
+                         "elastic replica control")
+    telemetry_flags(sp)
+
+    sp = sub.add_parser(
+        "autopilot",
+        help="SLO-driven self-healing control loop over a running "
+             "router: elastic replicas, degradation ladder, latency "
+             "quarantine, burn-episode profile capture "
+             "(workflow/autopilot.py)")
+    sp.add_argument("--router", required=True,
+                    help="router base URL, e.g. http://host:8100")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="journal would-have decisions without acting")
+    sp.add_argument("--replica-cmd", default="",
+                    help="shell command template (with a {port} "
+                         "placeholder) to spawn local replica "
+                         "subprocesses; empty disables elastic "
+                         "replica control")
     telemetry_flags(sp)
 
     sp = sub.add_parser("eventserver", help="start the event server")
@@ -1085,6 +1151,7 @@ _DISPATCH = {
     "profile": cmd_profile,
     "run": cmd_run,
     "router": cmd_router,
+    "autopilot": cmd_autopilot,
     "eventserver": cmd_eventserver,
     "dashboard": cmd_dashboard,
     "adminserver": cmd_adminserver,

@@ -58,6 +58,11 @@ _HBM_RED = 0.95
 #: is WARN
 _FAST_BURN_RED = 14.4
 _SLOW_BURN_WARN = 6.0
+#: fold-in event-to-servable freshness gate (the bench's
+#: foldin_freshness_p99 bound): a router response cache fronting a
+#: fold-in backend with a TTL above this can serve staler than the
+#: speed layer promises (KNOWN_ISSUES #17)
+_FOLDIN_FRESHNESS_GATE_MS = 2000.0
 
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
@@ -167,6 +172,16 @@ def scrape(base_url: str, timeout: float = 5.0) -> Dict[str, Any]:
                       ("events", "/debug/events.json?level=warn&limit=8")):
         status, body = _get(base_url, path, timeout)
         out[key] = {"status": status, "body": body}
+    root = _json_body(out["root"]) or {}
+    if root.get("router") and (root.get("cache") or {}).get("enabled"):
+        # cache-enabled router: fetch each backend's own root so the
+        # verdict can see a fold-in worker behind the cache (the
+        # KNOWN_ISSUES #17 TTL-vs-freshness operator trap)
+        out["backendRoots"] = [
+            {"status": s, "body": b}
+            for s, b in (_get(bk.get("url", ""), "/", timeout)
+                         for bk in root.get("backends") or []
+                         if bk.get("url"))]
     return out
 
 
@@ -375,6 +390,65 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
                            "the TTL is below the key re-visit interval"))
         else:
             checks.append(("router", OK, detail))
+
+        # KNOWN_ISSUES #17 mechanized: a response cache fronting a
+        # fold-in-enabled backend must keep its TTL at or below the
+        # fold-in freshness gate, or cached answers can outlive the
+        # event-to-answer bound the speed layer promises
+        if isinstance(cache, dict) and cache.get("enabled"):
+            foldin_backends = [
+                i for i, part in enumerate(
+                    scraped.get("backendRoots") or [])
+                if (_json_body(part) or {}).get("foldin") is not None]
+            ttl_ms = float(cache.get("ttlMs") or 0.0)
+            if foldin_backends and ttl_ms > _FOLDIN_FRESHNESS_GATE_MS:
+                checks.append((
+                    "router-cache", WARN,
+                    f"cache TTL {ttl_ms:g} ms fronts "
+                    f"{len(foldin_backends)} fold-in-enabled backend(s) "
+                    f"but exceeds the {_FOLDIN_FRESHNESS_GATE_MS:g} ms "
+                    "fold-in freshness gate — cached answers can serve "
+                    "staler than the speed layer promises; lower "
+                    "PIO_ROUTER_CACHE_TTL_MS or turn the cache off "
+                    "(KNOWN_ISSUES #17)"))
+            elif foldin_backends:
+                checks.append((
+                    "router-cache", OK,
+                    f"cache TTL {ttl_ms:g} ms within the "
+                    f"{_FOLDIN_FRESHNESS_GATE_MS:g} ms fold-in "
+                    "freshness gate"))
+
+        # autopilot (workflow/autopilot.py), embedded routers only -----
+        ap = root.get("autopilot")
+        if isinstance(ap, dict):
+            mode = ap.get("mode", "?")
+            last = ap.get("lastAction")
+            detail = f"mode {mode}"
+            if ap.get("ladderDepth"):
+                detail += (f", degradation ladder depth "
+                           f"{ap['ladderDepth']} (shed widened)")
+            if ap.get("holdoff"):
+                detail += ", HOLDING OFF (skew or reload barrier)"
+            if last:
+                detail += (f", last action {last.get('action', '?')} "
+                           f"({last.get('outcome', '?')}) "
+                           f"{last.get('ageS', '?')}s ago: "
+                           f"{last.get('trigger', '')}")
+            else:
+                detail += ", no actions yet"
+            cooling = ap.get("cooling") or []
+            if cooling:
+                detail += f", cooling: {', '.join(cooling)}"
+            pending = ap.get("pendingDryRun") or 0
+            if mode == "dry-run" and pending:
+                checks.append((
+                    "autopilot", WARN,
+                    detail + f" — {pending} would-have action(s) "
+                    "journaled but NOT applied; the loop believes the "
+                    "fleet needs intervention (drop --dry-run to let "
+                    "it act, or intervene by hand)"))
+            else:
+                checks.append(("autopilot", OK, detail))
 
     # multi-tenant registry (serving/registry.py) ----------------------
     tenants = root.get("tenants")

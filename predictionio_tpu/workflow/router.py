@@ -277,6 +277,10 @@ class _Backend:
         self.name = f"{self.host}:{self.port}"
         self.healthy = False
         self.admitted = True
+        #: autopilot hold-out: a latency-outlier replica is quarantined
+        #: (out of rotation) before its breaker trips, and re-admitted
+        #: explicitly — unlike ``healthy`` the poller never flips this
+        self.quarantined = False
         self.generation: Optional[int] = None
         #: per-tenant generation ids (multi-tenant backends report a
         #: dict on /readyz; None for a legacy single-engine replica)
@@ -411,11 +415,16 @@ class _Backend:
         out = {
             "url": self.url,
             "healthy": self.healthy,
-            "inRotation": self.healthy and self.admitted,
+            "inRotation": (self.healthy and self.admitted
+                           and not self.quarantined),
             "draining": self.draining,
             "generation": self.generation,
             "breaker": self.breaker.state,
         }
+        if self.quarantined:
+            # only while held out (wire parity: an untouched fleet's
+            # payload keeps the exact PR 15 key set)
+            out["quarantined"] = True
         if self.tenant_generations is not None:
             # only for multi-tenant replicas: a legacy fleet's status
             # payload keeps the exact PR 15 key set (wire parity)
@@ -446,7 +455,12 @@ class RouterAPI:
         #: request should surface, and the deadline (not a sleep curve)
         #: bounds the whole operation
         self._retry = resilience.RetryPolicy(max_attempts=2)
-        self._inflight = threading.Semaphore(self.config.max_inflight)
+        #: admission ceilings as plain counters (not a Semaphore): the
+        #: autopilot's degradation ladder adjusts them at runtime, and a
+        #: Semaphore's capacity cannot shrink under load
+        self._max_inflight = self.config.max_inflight
+        self._tenant_cap = self.config.tenant_max_inflight
+        self._inflight_count = 0
         self._stop_requested = threading.Event()
         self._draining = threading.Event()
         self._reload_lock = threading.Lock()
@@ -477,6 +491,10 @@ class RouterAPI:
             concurrent.futures.ThreadPoolExecutor] = None
         self._m_partition_requests = None
         self._m_partition_width = None
+        #: embedded autopilot (pio router --autopilot): set via
+        #: attach_autopilot; the status payload grows an "autopilot"
+        #: block only while one is attached (wire parity)
+        self._autopilot: Optional[Any] = None
         #: front-door response cache (None unless --cache/PIO_ROUTER_CACHE
         #: turns it on: the off path stays byte-identical to PR 16)
         self._cache: Optional[_ResponseCache] = None
@@ -511,6 +529,15 @@ class RouterAPI:
             "serialization)",
             buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                      0.01, 0.05, float("inf"))).child()
+        self._m_backend_seconds = reg.histogram(
+            "pio_router_backend_seconds",
+            "Backend call time per forwarded attempt, labeled by the "
+            "backend that served it — the per-replica latency signal "
+            "the autopilot's outlier quarantine reads (the aggregate "
+            "pio_router_overhead_seconds cannot name a slow replica)",
+            labelnames=("backend",),
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 1.0, float("inf")))
         self._m_backend_up = reg.gauge(
             "pio_router_backend_up",
             "1 while this backend is in rotation (healthy + admitted by "
@@ -583,7 +610,8 @@ class RouterAPI:
                     level=(journal.WARN if draining else journal.RED),
                     backend=b.name, draining=draining)
             self._m_backend_up.labels(backend=b.name).set(
-                1.0 if (healthy and b.admitted) else 0.0)
+                1.0 if (healthy and b.admitted and not b.quarantined)
+                else 0.0)
         self._rebuild_pmap()
         self._cache_sweep()
 
@@ -612,6 +640,111 @@ class RouterAPI:
             self._m_backend_up.labels(backend=b.name).set(0.0)
             self._rebuild_pmap()
 
+    # -------------------------------------------------- fleet control plane
+    def add_backend(self, url: str) -> _Backend:
+        """Admit a new replica into the configured set (the autopilot's
+        scale-up / replacement path). The newcomer is probed
+        synchronously so an already-ready replica enters rotation on
+        this call, not a poll interval later."""
+        b = _Backend(url)
+        with self._lock:
+            if any(x.name == b.name for x in self.backends):
+                raise ValueError(
+                    f"backend {b.name} is already configured")
+            self.backends.append(b)
+        healthy, draining, gen, tenant_gens, partition = b.probe()
+        with self._lock:
+            b.healthy = healthy
+            b.draining = draining
+            if gen is not None:
+                b.generation = gen
+            if tenant_gens is not None:
+                b.tenant_generations = tenant_gens
+            if healthy:
+                b.partition = partition
+        self._m_backend_up.labels(backend=b.name).set(
+            1.0 if healthy else 0.0)
+        journal.emit(
+            "router", f"backend {b.name} added to the fleet "
+            + ("(in rotation)" if healthy else "(awaiting readiness)"),
+            level=journal.INFO, backend=b.name, healthy=healthy)
+        self._rebuild_pmap()
+        return b
+
+    def remove_backend(self, name: str) -> bool:
+        """Retire one backend by name. Membership removal is immediate
+        — in-flight forwards finish on their already-open sockets — so
+        a scale-down that stops the PROCESS a grace period later never
+        drops a query. Returns False for an unknown name."""
+        with self._lock:
+            found = next((b for b in self.backends if b.name == name),
+                         None)
+            if found is None:
+                return False
+            if len(self.backends) == 1:
+                raise ValueError("cannot remove the last backend")
+            found.admitted = False
+            self.backends.remove(found)
+        found.close()
+        self._m_backend_up.labels(backend=found.name).set(0.0)
+        journal.emit(
+            "router", f"backend {found.name} removed from the fleet",
+            level=journal.INFO, backend=found.name)
+        self._rebuild_pmap()
+        return True
+
+    def set_quarantine(self, name: str, value: bool) -> bool:
+        """Hold one backend out of rotation (or release it) without
+        touching its health state — the autopilot's latency-outlier
+        ejection. Returns False for an unknown name."""
+        with self._lock:
+            found = next((b for b in self.backends if b.name == name),
+                         None)
+            if found is None:
+                return False
+            changed = found.quarantined != value
+            found.quarantined = value
+        if changed:
+            self._m_backend_up.labels(backend=found.name).set(
+                1.0 if (found.healthy and found.admitted and not value)
+                else 0.0)
+            journal.emit(
+                "router", f"backend {found.name} "
+                + ("quarantined (held out of rotation)" if value
+                   else "released from quarantine"),
+                level=journal.WARN if value else journal.INFO,
+                backend=found.name, quarantined=value)
+            self._rebuild_pmap()
+        return True
+
+    def set_shed_thresholds(self, max_inflight: Optional[int] = None,
+                            tenant_max_inflight: Optional[int] = None
+                            ) -> Dict[str, int]:
+        """Read (no args) or adjust the shed thresholds at runtime;
+        returns the PREVIOUS values so the autopilot's degradation
+        ladder can restore them exactly on recovery."""
+        with self._lock:
+            prev = {"maxInflight": self._max_inflight,
+                    "tenantMaxInflight": self._tenant_cap}
+            if max_inflight is not None:
+                self._max_inflight = max(1, int(max_inflight))
+            if tenant_max_inflight is not None:
+                self._tenant_cap = max(0, int(tenant_max_inflight))
+            cur = {"maxInflight": self._max_inflight,
+                   "tenantMaxInflight": self._tenant_cap}
+        if cur != prev:
+            journal.emit(
+                "router",
+                f"shed thresholds changed: maxInflight "
+                f"{prev['maxInflight']} -> {cur['maxInflight']}, "
+                f"tenantMaxInflight {prev['tenantMaxInflight']} -> "
+                f"{cur['tenantMaxInflight']}",
+                level=journal.INFO, **cur)
+        return prev
+
+    def attach_autopilot(self, ap: Any) -> None:
+        self._autopilot = ap
+
     # ------------------------------------------------------ partition map
     def _rebuild_pmap(self) -> None:
         """Recompute the partition map from current membership and swap
@@ -628,7 +761,8 @@ class RouterAPI:
         are immutable once published."""
         with self._lock:
             part = [b for b in self.backends
-                    if b.healthy and b.admitted and b.partition]
+                    if b.healthy and b.admitted and not b.quarantined
+                    and b.partition]
             old = self._pmap
             if not part:
                 had_parts = any(b.partition for b in self.backends)
@@ -715,7 +849,7 @@ class RouterAPI:
         votes = set()
         with self._lock:
             for b in self.backends:
-                if not (b.healthy and b.admitted):
+                if not (b.healthy and b.admitted and not b.quarantined):
                     continue
                 if b.tenant_generations is not None:
                     g = b.tenant_generations.get(tenant)
@@ -772,7 +906,8 @@ class RouterAPI:
 
     def _eligible(self) -> List[_Backend]:
         with self._lock:
-            return [b for b in self.backends if b.healthy and b.admitted]
+            return [b for b in self.backends
+                    if b.healthy and b.admitted and not b.quarantined]
 
     def _pick(self, exclude: Optional[set] = None) -> Optional[_Backend]:
         """Round-robin over the rotation, skipping excluded backends and
@@ -815,6 +950,12 @@ class RouterAPI:
                 return self._queries(body, headers or {}, query or {})
             if path == "/reload" and method == "POST":
                 return self._start_reload(query or {})
+            if path == "/backends" and method == "POST":
+                return self._backends_route(query or {})
+            if path == "/quarantine" and method == "POST":
+                return self._quarantine_route(query or {})
+            if path == "/shed" and method == "POST":
+                return self._shed_route(query or {})
             if path == "/stop" and method == "POST":
                 self._stop_requested.set()
                 return 200, {"message": "Shutting down."}
@@ -887,7 +1028,55 @@ class RouterAPI:
             # cache-enabled routers only (same parity rule): the stats
             # the doctor's hit-ratio WARN reads
             out["cache"] = {"enabled": True, **cache.stats()}
+        if self._autopilot is not None:
+            # embedded-autopilot routers only (same parity rule): the
+            # block `pio doctor`'s autopilot line reads
+            out["autopilot"] = self._autopilot.summary()
         return out
+
+    # ------------------------------------------------------- admin routes
+    def _backends_route(self, query: Dict[str, str]) -> Response:
+        add, remove = query.get("add"), query.get("remove")
+        if bool(add) == bool(remove):
+            return 400, {"message": ("POST /backends needs exactly one "
+                                     "of ?add=url or ?remove=name")}
+        try:
+            if add:
+                b = self.add_backend(add)
+                return 200, {"message": f"backend {b.name} added.",
+                             "backend": b.state()}
+            if not self.remove_backend(remove or ""):
+                return 404, {"message": f"unknown backend {remove}"}
+            return 200, {"message": f"backend {remove} removed."}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+
+    def _quarantine_route(self, query: Dict[str, str]) -> Response:
+        name = query.get("backend", "")
+        if not name:
+            return 400, {"message":
+                         "POST /quarantine needs ?backend=name"}
+        clear = (query.get("clear") or "") in ("1", "true", "yes")
+        if not self.set_quarantine(name, not clear):
+            return 404, {"message": f"unknown backend {name}"}
+        return 200, {"message": f"backend {name} "
+                     + ("released from quarantine."
+                        if clear else "quarantined.")}
+
+    def _shed_route(self, query: Dict[str, str]) -> Response:
+        try:
+            mi = query.get("maxInflight")
+            ti = query.get("tenantMaxInflight")
+            prev = self.set_shed_thresholds(
+                max_inflight=int(mi) if mi is not None else None,
+                tenant_max_inflight=int(ti) if ti is not None else None)
+        except ValueError:
+            return 400, {"message": ("maxInflight/tenantMaxInflight "
+                                     "must be integers")}
+        with self._lock:
+            cur = {"maxInflight": self._max_inflight,
+                   "tenantMaxInflight": self._tenant_cap}
+        return 200, {"previous": prev, "current": cur}
 
     def _readyz(self) -> Response:
         """Ready while at least one backend is in rotation — the router's
@@ -960,7 +1149,8 @@ class RouterAPI:
                         self._m_overhead.observe(
                             max(time.perf_counter() - t_start, 0.0))
                     return hit
-        cap = self.config.tenant_max_inflight
+        with self._lock:
+            cap = self._tenant_cap
         charged = False
         if key and cap > 0:
             # per-tenant shedding at the front door: one tenant's flood
@@ -980,7 +1170,13 @@ class RouterAPI:
                     {"Retry-After": "1"}
             charged = True
         try:
-            if not self._inflight.acquire(blocking=False):
+            with self._lock:
+                if self._inflight_count >= self._max_inflight:
+                    admitted = False
+                else:
+                    self._inflight_count += 1
+                    admitted = True
+            if not admitted:
                 # admission control: the fleet is saturated end to end;
                 # queueing here would only grow latency without bound
                 self._shed("inflight", tenant=tenant)
@@ -1008,7 +1204,8 @@ class RouterAPI:
                         self._cache_metrics_update()
                 return resp
             finally:
-                self._inflight.release()
+                with self._lock:
+                    self._inflight_count -= 1
         finally:
             if charged:
                 with self._lock:
@@ -1107,8 +1304,14 @@ class RouterAPI:
                 return 502, {"message": (
                     f"backend {b.name} failed ({type(e).__name__}) and "
                     "the failover budget is spent")}
-            backend_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            backend_s += dt
             b.breaker.record(status < 500)
+            if telemetry.on():
+                # the per-replica latency signal the autopilot's outlier
+                # quarantine compares across the fleet
+                self._m_backend_seconds.labels(
+                    backend=b.name).observe(dt)
             if status in (502, 503, 504) and self._retry.may_retry(
                     attempt, deadline, clock=time.perf_counter):
                 # a draining/saturated replica said "not me" — that is
@@ -1175,6 +1378,7 @@ class RouterAPI:
                     return "deadline", None, None
                 hdrs = {**fwd_headers,
                         "X-PIO-Deadline-Ms": str(int(remaining * 1e3))}
+                t0 = time.perf_counter()
                 try:
                     with tracing.activate(ctx):
                         if ctx is not None:
@@ -1192,6 +1396,10 @@ class RouterAPI:
                     last_err = f"{b.name}: {type(e).__name__}"
                     continue
                 b.breaker.record(status < 500)
+                if telemetry.on():
+                    self._m_backend_seconds.labels(
+                        backend=b.name).observe(
+                            time.perf_counter() - t0)
                 if status in (502, 503, 504):
                     # per-partition failover: a draining/saturated
                     # replica said "not me" — try its partition peers
@@ -1418,7 +1626,8 @@ class RouterAPI:
                 b.admitted = value
         for b in backends:
             self._m_backend_up.labels(backend=b.name).set(
-                1.0 if (b.healthy and value) else 0.0)
+                1.0 if (b.healthy and value and not b.quarantined)
+                else 0.0)
         # admission changes re-shape the partition map (the barrier's
         # coordinated re-partition rides the same atomic map swap)
         self._rebuild_pmap()
@@ -1509,7 +1718,8 @@ class RouterAPI:
             last.admitted = False
         for b in flipped + [last]:
             self._m_backend_up.labels(backend=b.name).set(
-                1.0 if (b.healthy and b.admitted) else 0.0)
+                1.0 if (b.healthy and b.admitted and not b.quarantined)
+                else 0.0)
         self._rebuild_pmap()
         journal.emit(
             "router", f"reload barrier cutover: {len(flipped)} backend(s) "

@@ -1,0 +1,706 @@
+"""`pio autopilot` — SLO-driven self-healing (workflow/autopilot.py).
+
+The contracts under test:
+
+- chaos convergence e2e: with the autopilot live against an in-process
+  fleet, a replica kill under a concurrent burst recovers to full
+  rotation (spawn + corpse removal) with ZERO non-503 failures and
+  every action journaled with its triggering evidence;
+- `--dry-run` provably acts on nothing: fleet state byte-identical
+  before/after while would-have decisions are journaled and counted;
+- the degradation ladder is reversible and hysteretic: burn >= 14.4x
+  on BOTH windows widens shedding one rung, recovery restores the
+  EXACT prior thresholds, and no action class fires twice within one
+  cooldown under a flapping signal;
+- quarantine ejects a fleet-outlier p99 backend before its breaker
+  trips and re-admits on probe recovery;
+- the loop NEVER acts under generation skew or a running reload
+  barrier (hold-off, journaled once per transition);
+- the router's new control plane: POST /backends, /quarantine, /shed
+  (read + adjust + exact restore), and the per-backend
+  pio_router_backend_seconds histogram the outlier detector reads.
+"""
+
+import http.client
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.common import journal, telemetry
+from predictionio_tpu.tools import doctor
+from predictionio_tpu.workflow.autopilot import (
+    Autopilot, AutopilotConfig, LocalRouterControl, ReplicaPool,
+    RouterControl, Signals,
+)
+from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
+
+from tests.test_router import (_post_query, _replica, _router,
+                               _train_seeded)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    journal.clear()
+    telemetry.set_enabled(None)
+    yield
+    telemetry.set_enabled(None)
+
+
+def _cfg(**kw):
+    kw.setdefault("poll_ms", 100.0)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("util_low", 0.2)
+    kw.setdefault("util_high", 0.85)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("outlier_x", 3.0)
+    kw.setdefault("profile_ms", 500)
+    return AutopilotConfig(**kw)
+
+
+class FakeControl(RouterControl):
+    """In-memory router stand-in; records every mutation so dry-run
+    tests can assert NOTHING was touched."""
+
+    def __init__(self):
+        self.max_inflight = 64
+        self.tenant_cap = 8
+        self.quarantine_state = {}
+        self.calls = []
+
+    def status(self):
+        return {"router": True, "backends": []}
+
+    def metrics_text(self):
+        return ""
+
+    def add_backend(self, url):
+        self.calls.append(("add", url))
+
+    def remove_backend(self, name):
+        self.calls.append(("remove", name))
+
+    def set_quarantine(self, name, value):
+        self.calls.append(("quarantine", name, value))
+        self.quarantine_state[name] = value
+
+    def shed_thresholds(self):
+        return {"maxInflight": self.max_inflight,
+                "tenantMaxInflight": self.tenant_cap}
+
+    def set_shed(self, max_inflight=None, tenant_max_inflight=None):
+        prev = self.shed_thresholds()
+        self.calls.append(("set_shed", max_inflight, tenant_max_inflight))
+        if max_inflight is not None:
+            self.max_inflight = max_inflight
+        if tenant_max_inflight is not None:
+            self.tenant_cap = tenant_max_inflight
+        return prev
+
+    def backend_post(self, backend_url, path, timeout=5.0):
+        self.calls.append(("post", backend_url, path))
+        return 202
+
+
+class FakePool(ReplicaPool):
+    def __init__(self):
+        self.spawned = []
+        self.stopped = []
+        self._n = 0
+
+    def spawn(self):
+        self._n += 1
+        url = f"http://127.0.0.1:{9900 + self._n}"
+        self.spawned.append(url)
+        return url
+
+    def stop(self, url):
+        self.stopped.append(url)
+        return True
+
+
+def _f(event):
+    """journal.emit(**fields) lands under the record's "fields" key."""
+    return event.get("fields") or {}
+
+
+def _sig(now, burn=0.0, **kw):
+    kw.setdefault("in_rotation", ["a:1", "b:2"])
+    kw.setdefault("healthy", list(kw["in_rotation"]))
+    kw.setdefault("urls", {n: f"http://{n}" for n in kw["in_rotation"]})
+    return Signals(now=now, burn_fast=burn, burn_slow=burn, **kw)
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: reversible + hysteretic
+# ---------------------------------------------------------------------------
+
+def test_ladder_widen_requires_both_windows():
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg())
+    # fast alight alone (a short spike the slow window absorbs) is not
+    # the page condition — nothing moves
+    acted = ap.tick(Signals(now=0.0, in_rotation=["a:1"],
+                            burn_fast=20.0, burn_slow=2.0))
+    assert acted == []
+    assert ctl.calls == []
+
+
+def test_ladder_flap_is_hysteretic_and_restores_exact_thresholds():
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=10.0))
+    # page -> one rung down: thresholds halved
+    acted = ap.tick(_sig(0.0, burn=20.0))
+    assert [a["action"] for a in acted] == ["shed_widen", "profile_capture"]
+    assert ctl.max_inflight == 32 and ctl.tenant_cap == 4
+    # flapping INSIDE the cooldown: recovery then re-page — the shed
+    # class must not oscillate
+    assert ap.tick(_sig(2.0, burn=0.1)) == []
+    assert ap.tick(_sig(4.0, burn=20.0)) == []
+    assert ctl.max_inflight == 32 and ctl.tenant_cap == 4
+    # cooldown passed + burn subsided -> the rung pops, restoring the
+    # EXACT prior thresholds
+    acted = ap.tick(_sig(11.0, burn=0.1))
+    assert [a["action"] for a in acted] == ["shed_narrow"]
+    assert ctl.max_inflight == 64 and ctl.tenant_cap == 8
+    assert ap.summary()["ladderDepth"] == 0
+    # exactly one widen and one narrow across the whole flap
+    widens = [c for c in ctl.calls if c[0] == "set_shed"]
+    assert len(widens) == 2
+
+
+def test_ladder_multi_rung_unwinds_in_order():
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=10.0))
+    ap.tick(_sig(0.0, burn=20.0))      # 64 -> 32
+    ap.tick(_sig(11.0, burn=20.0))     # 32 -> 16
+    assert ctl.max_inflight == 16 and ctl.tenant_cap == 2
+    assert ap.summary()["ladderDepth"] == 2
+    ap.tick(_sig(22.0, burn=0.1))      # -> 32
+    assert ctl.max_inflight == 32 and ctl.tenant_cap == 4
+    ap.tick(_sig(33.0, burn=0.1))      # -> 64, exactly where it began
+    assert ctl.max_inflight == 64 and ctl.tenant_cap == 8
+    assert ap.summary()["ladderDepth"] == 0
+
+
+def test_profile_capture_once_per_burn_episode():
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=1.0, profile_ms=500))
+    ap.tick(_sig(0.0, burn=20.0))
+    posts = [c for c in ctl.calls if c[0] == "post"]
+    assert len(posts) == 1
+    assert posts[0][2] == "/debug/profile?ms=500"
+    # sustained burn: still ONE capture for the episode
+    ap.tick(_sig(5.0, burn=20.0))
+    assert len([c for c in ctl.calls if c[0] == "post"]) == 1
+    # episode ends, a NEW one captures again
+    ap.tick(_sig(10.0, burn=0.1))
+    ap.tick(_sig(20.0, burn=20.0))
+    assert len([c for c in ctl.calls if c[0] == "post"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# elastic replica control (fake pool)
+# ---------------------------------------------------------------------------
+
+def test_scale_band_spawns_and_drains():
+    ctl = FakeControl()
+    pool = FakePool()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=1.0, min_replicas=1,
+                                    max_replicas=4), pool=pool)
+    # hot: busy fraction over the ceiling
+    acted = ap.tick(_sig(0.0, utilization=0.95))
+    assert [a["action"] for a in acted] == ["scale_up"]
+    assert len(pool.spawned) == 1
+    assert ("add", pool.spawned[0]) in ctl.calls
+    # cold: busy fraction under the floor -> drain the last replica,
+    # membership first, process stop only after the grace period
+    acted = ap.tick(_sig(2.0, utilization=0.02))
+    assert [a["action"] for a in acted] == ["scale_down"]
+    assert ("remove", "b:2") in ctl.calls
+    assert pool.stopped == []                  # still draining
+    ap.tick(_sig(10.0, utilization=0.5))       # grace passed
+    assert pool.stopped == ["http://b:2"]
+
+
+def test_dead_replica_refills_to_min():
+    ctl = FakeControl()
+    pool = FakePool()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=1.0, min_replicas=2),
+                   pool=pool)
+    acted = ap.tick(_sig(0.0, in_rotation=["a:1"], healthy=["a:1"],
+                         unhealthy=["dead:9"]))
+    assert [a["action"] for a in acted] == ["scale_up"]
+    assert len(pool.spawned) == 1
+    # the corpse is retired once its replacement is admitted
+    assert ("remove", "dead:9") in ctl.calls
+
+
+def test_no_pool_means_no_replica_control():
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(min_replicas=3))
+    assert ap.tick(_sig(0.0, in_rotation=["a:1"], healthy=["a:1"],
+                        utilization=0.99)) == []
+    assert ctl.calls == []
+
+
+# ---------------------------------------------------------------------------
+# quarantine: outlier ejection + probe-recovery re-admission
+# ---------------------------------------------------------------------------
+
+def test_quarantine_outlier_and_readmit():
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=5.0, outlier_x=3.0))
+    rot = ["a:1", "b:2", "c:3"]
+    p99 = {"a:1": (0.001, 100.0), "b:2": (0.0012, 100.0),
+           "c:3": (0.02, 100.0)}
+    acted = ap.tick(_sig(0.0, in_rotation=list(rot), backend_p99=p99))
+    assert [a["action"] for a in acted] == ["quarantine"]
+    assert ctl.quarantine_state == {"c:3": True}
+    ev = journal.snapshot(category="autopilot")["events"]
+    quar = next(_f(e) for e in ev
+                if _f(e).get("action") == "quarantine")
+    assert quar["backend"] == "c:3" and "p99Ms" in quar
+    # probe recovered + cooldown passed -> re-admit
+    acted = ap.tick(_sig(6.0, in_rotation=["a:1", "b:2"],
+                         healthy=rot, quarantined=["c:3"]))
+    assert [a["action"] for a in acted] == ["readmit"]
+    assert ctl.quarantine_state == {"c:3": False}
+
+
+def test_quarantine_needs_peers_and_floor():
+    p99 = {"a:1": (0.001, 100.0), "b:2": (0.0012, 100.0),
+           "c:3": (0.02, 100.0)}
+    # only two in-rotation candidates: no fleet median to vote an
+    # outlier against
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(min_replicas=1, outlier_x=3.0))
+    assert ap.tick(_sig(0.0, backend_p99=dict(p99))) == []
+    assert ctl.calls == []
+    # three candidates, but holding one out would drop the rotation
+    # below min_replicas
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(min_replicas=3, outlier_x=3.0))
+    assert ap.tick(_sig(0.0, in_rotation=["a:1", "b:2", "c:3"],
+                        backend_p99=dict(p99))) == []
+    # too few samples in the window: microbenchmark noise is not
+    # evidence
+    ctl = FakeControl()
+    ap = Autopilot(ctl, config=_cfg(min_replicas=1, outlier_x=3.0))
+    assert ap.tick(_sig(0.0, in_rotation=["a:1", "b:2", "c:3"],
+                        backend_p99={k: (p, 3.0)
+                                     for k, (p, _c) in p99.items()})) \
+        == []
+    assert ctl.calls == []
+
+
+# ---------------------------------------------------------------------------
+# hold-off: never act under skew or a running barrier
+# ---------------------------------------------------------------------------
+
+def test_holdoff_under_skew_and_reload():
+    ctl = FakeControl()
+    pool = FakePool()
+    ap = Autopilot(ctl, config=_cfg(cooldown_s=1.0, min_replicas=3),
+                   pool=pool)
+    # every trigger is alight, but the fleet disagrees on generations
+    hot = dict(in_rotation=["a:1"], healthy=["a:1"], burn_fast=20.0,
+               burn_slow=20.0)
+    assert ap.tick(Signals(now=0.0, generation_skew=True, **hot)) == []
+    assert ap.tick(Signals(now=2.0, reload_active=True, **hot)) == []
+    assert ctl.calls == [] and pool.spawned == []
+    ev = journal.snapshot(category="autopilot")["events"]
+    assert sum("holding off" in e["message"] for e in ev) == 1
+    # skew clears -> control resumes (and the resume is journaled)
+    acted = ap.tick(Signals(now=4.0, **hot))
+    assert any(a["action"] == "scale_up" for a in acted)
+    ev = journal.snapshot(category="autopilot")["events"]
+    assert any("resuming control" in e["message"] for e in ev)
+
+
+# ---------------------------------------------------------------------------
+# dry-run: provably acts on nothing
+# ---------------------------------------------------------------------------
+
+def test_dry_run_journals_but_never_acts():
+    ctl = FakeControl()
+    pool = FakePool()
+    ap = Autopilot(ctl, config=_cfg(dry_run=True, cooldown_s=1.0,
+                                    min_replicas=3), pool=pool)
+    before = (ctl.max_inflight, ctl.tenant_cap,
+              dict(ctl.quarantine_state))
+    acted = ap.tick(_sig(0.0, in_rotation=["a:1"], healthy=["a:1"],
+                         burn=20.0, backend_p99={
+                             "a:1": (0.02, 100.0),
+                             "b:2": (0.001, 100.0),
+                             "c:3": (0.001, 100.0)}))
+    assert acted and all(a["outcome"] == "dry_run" for a in acted)
+    # NOTHING was touched: no control mutations, no spawns, and the
+    # ladder stack stayed empty (a dry rung would corrupt a later
+    # live restore)
+    assert ctl.calls == [] and pool.spawned == []
+    assert (ctl.max_inflight, ctl.tenant_cap,
+            dict(ctl.quarantine_state)) == before
+    assert ap.summary()["ladderDepth"] == 0
+    assert ap.summary()["pendingDryRun"] == len(acted)
+    ev = journal.snapshot(category="autopilot")["events"]
+    would = [e for e in ev if _f(e).get("dryRun")]
+    assert would and all(e["message"].startswith("DRY-RUN would")
+                         for e in would)
+    # the cooldown still charges: a dry-run pacing differently from
+    # the live loop it rehearses would be a lie
+    assert ap.tick(_sig(0.5, burn=20.0, in_rotation=["a:1"],
+                        healthy=["a:1"])) == []
+
+
+# ---------------------------------------------------------------------------
+# router control plane (real RouterAPI)
+# ---------------------------------------------------------------------------
+
+def _lone_router():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return RouterAPI(RouterConfig(
+        backends=(f"http://127.0.0.1:{port}",), health_ms=60000.0,
+        max_inflight=64, tenant_max_inflight=8))
+
+
+def test_router_shed_route_reads_and_restores():
+    router = _lone_router()
+    try:
+        st, body = router.handle("POST", "/shed")[:2]
+        assert st == 200
+        assert body["current"] == {"maxInflight": 64,
+                                   "tenantMaxInflight": 8}
+        st, body = router.handle(
+            "POST", "/shed",
+            query={"maxInflight": "32", "tenantMaxInflight": "4"})[:2]
+        assert body["previous"] == {"maxInflight": 64,
+                                    "tenantMaxInflight": 8}
+        assert body["current"] == {"maxInflight": 32,
+                                   "tenantMaxInflight": 4}
+        # restore from the returned previous: bit-identical round trip
+        prev = body["previous"]
+        router.set_shed_thresholds(
+            max_inflight=prev["maxInflight"],
+            tenant_max_inflight=prev["tenantMaxInflight"])
+        assert router.handle("POST", "/shed")[1]["current"] == prev
+        # floors: maxInflight clamps to >= 1, tenant cap to >= 0
+        router.set_shed_thresholds(max_inflight=0,
+                                   tenant_max_inflight=-5)
+        cur = router.handle("POST", "/shed")[1]["current"]
+        assert cur == {"maxInflight": 1, "tenantMaxInflight": 0}
+    finally:
+        router.close()
+
+
+def test_router_backend_and_quarantine_routes_validate():
+    router = _lone_router()
+    name = router.backends[0].name
+    try:
+        assert router.handle("POST", "/backends")[0] == 400
+        assert router.handle("POST", "/backends",
+                             query={"add": "no-port"})[0] == 400
+        assert router.handle("POST", "/backends",
+                             query={"remove": "nope:1"})[0] == 404
+        # the last backend is not removable (a router with zero
+        # configured backends could never recover by itself)
+        assert router.handle("POST", "/backends",
+                             query={"remove": name})[0] == 400
+        assert router.handle("POST", "/quarantine")[0] == 400
+        assert router.handle("POST", "/quarantine",
+                             query={"backend": "nope:1"})[0] == 404
+        st, body = router.handle("POST", "/quarantine",
+                                 query={"backend": name})[:2]
+        assert st == 200
+        state = router.handle("GET", "/")[1]["backends"][0]
+        assert state["quarantined"] is True and not state["inRotation"]
+        router.handle("POST", "/quarantine",
+                      query={"backend": name, "clear": "1"})
+        state = router.handle("GET", "/")[1]["backends"][0]
+        assert "quarantined" not in state       # wire parity when clear
+    finally:
+        router.close()
+
+
+def test_router_status_has_no_autopilot_block_until_attached():
+    router = _lone_router()
+    try:
+        assert "autopilot" not in router.handle("GET", "/")[1]
+        ap = Autopilot(LocalRouterControl(router), config=_cfg())
+        router.attach_autopilot(ap)
+        block = router.handle("GET", "/")[1]["autopilot"]
+        assert block["mode"] == "live" and block["actionsTotal"] == 0
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# per-backend latency histogram (the quarantine blind-spot fix)
+# ---------------------------------------------------------------------------
+
+def test_per_backend_latency_histogram(memory_storage):
+    engine = _train_seeded(memory_storage, app_name="ApHist")
+    api1, server1, port1 = _replica(memory_storage, engine)
+    api2, server2, port2 = _replica(memory_storage, engine)
+    router, rserver, rport = _router([port1, port2])
+    telemetry.set_enabled(True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                          timeout=10)
+        for _ in range(6):
+            status, _body, _h = _post_query(conn)
+            assert status == 200
+        conn.close()
+        samples = doctor.parse_metrics(
+            telemetry.registry().exposition())
+        backends = set()
+        for labels, _v in samples.get(
+                "pio_router_backend_seconds_bucket", []):
+            m = re.search(r'backend="([^"]+)"', labels)
+            if m:
+                backends.add(m.group(1))
+        # round-robin over two replicas: BOTH carry their own series —
+        # the aggregate pio_router_overhead_seconds cannot name a slow
+        # replica, this can
+        assert {f"127.0.0.1:{port1}", f"127.0.0.1:{port2}"} <= backends
+    finally:
+        rserver.shutdown()
+        router.close()
+        for s, a in ((server1, api1), (server2, api2)):
+            s.shutdown()
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: chaos convergence + dry-run inertness against a real fleet
+# ---------------------------------------------------------------------------
+
+class InProcessPool(ReplicaPool):
+    """Spawns real query-server replicas inside the test process (the
+    ReplicaPool hook contract an external orchestrator implements)."""
+
+    def __init__(self, storage, engine):
+        self.storage = storage
+        self.engine = engine
+        self.live = {}
+        self.spawn_calls = 0
+
+    def spawn(self):
+        self.spawn_calls += 1
+        api, server, port = _replica(self.storage, self.engine)
+        url = f"http://127.0.0.1:{port}"
+        self.live[url] = (api, server)
+        return url
+
+    def stop(self, url):
+        pair = self.live.pop(url, None)
+        if pair is None:
+            return False
+        pair[1].shutdown()
+        pair[0].close()
+        return True
+
+    def close(self):
+        for url in list(self.live):
+            self.stop(url)
+
+
+@pytest.mark.chaos
+def test_autopilot_chaos_convergence_e2e(memory_storage):
+    """A replica SIGKILL (in-process: server shutdown severs the
+    keep-alive sockets) under a concurrent burst. The live autopilot
+    must converge the fleet back to full rotation with zero human
+    input and zero non-503 client failures."""
+    engine = _train_seeded(memory_storage, app_name="ApChaos")
+    api1, server1, port1 = _replica(memory_storage, engine)
+    api2, server2, port2 = _replica(memory_storage, engine)
+    router, rserver, rport = _router([port1, port2])
+    pool = InProcessPool(memory_storage, engine)
+    ap = Autopilot(LocalRouterControl(router),
+                   config=_cfg(poll_ms=100.0, cooldown_s=1.0,
+                               min_replicas=2, max_replicas=3),
+                   pool=pool)
+    t = threading.Thread(target=ap.run, daemon=True)
+    bad, stop = [], threading.Event()
+
+    def burst():
+        conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                          timeout=10)
+        while not stop.is_set():
+            try:
+                status, _b, _h = _post_query(conn)
+            except Exception:
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                                  timeout=10)
+                continue
+            if status not in (200, 503):
+                bad.append(status)
+        conn.close()
+
+    workers = [threading.Thread(target=burst, daemon=True)
+               for _ in range(4)]
+    try:
+        t.start()
+        for w in workers:
+            w.start()
+        time.sleep(0.5)
+        # the kill, mid-burst
+        server1.shutdown()
+        api1.close()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = router.handle("GET", "/")[1]
+            if (st["inRotation"] == 2
+                    and all(b["inRotation"] for b in st["backends"])):
+                break
+            time.sleep(0.1)
+        stop.set()
+        for w in workers:
+            w.join(timeout=10)
+        st = router.handle("GET", "/")[1]
+        # converged: the corpse was replaced and retired, full rotation
+        assert st["inRotation"] == 2, st
+        assert len(st["backends"]) == 2, st
+        assert f"http://127.0.0.1:{port1}" not in {
+            b["url"] for b in st["backends"]}, st
+        assert pool.spawn_calls >= 1
+        # zero non-503 failures through the whole episode
+        assert bad == [], bad
+        # every action journaled with its triggering evidence
+        ev = journal.snapshot(category="autopilot")["events"]
+        ups = [_f(e) for e in ev
+               if _f(e).get("action") == "scale_up"]
+        assert ups, [e["message"] for e in ev]
+        assert ups[0]["outcome"] == "ok"
+        assert ups[0]["minReplicas"] == 2
+        assert "inRotation" in ups[0]
+    finally:
+        stop.set()
+        ap.close()
+        t.join(timeout=10)
+        rserver.shutdown()
+        router.close()
+        server2.shutdown()
+        api2.close()
+        pool.close()
+
+
+def test_autopilot_dry_run_leaves_fleet_byte_identical(memory_storage):
+    """--dry-run against a real under-replicated fleet: the loop wants
+    to scale up, journals the would-have, and the fleet state is
+    byte-identical before and after."""
+    engine = _train_seeded(memory_storage, app_name="ApDry")
+    api1, server1, port1 = _replica(memory_storage, engine)
+    router, rserver, rport = _router([port1])
+    pool = InProcessPool(memory_storage, engine)
+    ap = Autopilot(LocalRouterControl(router),
+                   config=_cfg(dry_run=True, poll_ms=50.0,
+                               cooldown_s=0.2, min_replicas=2),
+                   pool=pool)
+    try:
+        before = json.dumps(router.handle("GET", "/")[1],
+                            sort_keys=True)
+        for i in range(5):
+            ap.tick(ap.gather())
+            time.sleep(0.25)
+        after = json.dumps(router.handle("GET", "/")[1], sort_keys=True)
+        assert after == before
+        assert pool.spawn_calls == 0
+        summary = ap.summary()
+        assert summary["mode"] == "dry-run"
+        assert summary["pendingDryRun"] >= 1
+        assert summary["lastAction"]["outcome"] == "dry_run"
+        ev = journal.snapshot(category="autopilot")["events"]
+        assert any(_f(e).get("action") == "scale_up"
+                   and _f(e).get("dryRun") for e in ev)
+    finally:
+        ap.close()
+        rserver.shutdown()
+        router.close()
+        server1.shutdown()
+        api1.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor surface
+# ---------------------------------------------------------------------------
+
+def _scraped_router(root):
+    ok = {"status": 200, "body": json.dumps({"status": "ok"})}
+    return {
+        "url": "http://t", "healthz": dict(ok), "readyz": dict(ok),
+        "root": {"status": 200, "body": json.dumps(root)},
+        "metrics": {"status": 200, "body": ""},
+        "traces": {"status": 404, "body": ""},
+        "device": {"status": 200, "body": json.dumps(
+            {"telemetry": True})},
+    }
+
+
+def _base_root(**kw):
+    root = {"router": True, "backends": [
+        {"url": "http://h:1", "inRotation": True, "healthy": True,
+         "generation": 1, "breaker": "closed"}],
+        "generations": [1], "generationSkew": False}
+    root.update(kw)
+    return root
+
+
+def test_doctor_autopilot_line_ok_and_dry_run_warn():
+    root = _base_root(autopilot={
+        "mode": "live", "ladderDepth": 1, "holdoff": False,
+        "cooldownS": 30.0, "cooling": ["shed"], "actionsTotal": 3,
+        "pendingDryRun": 0,
+        "lastAction": {"action": "shed_widen", "outcome": "ok",
+                       "trigger": "burn 16.0x/15.1x over the page "
+                                  "threshold", "ageS": 12.0}})
+    checks = doctor.diagnose(_scraped_router(root))
+    check = next(c for c in checks if c[0] == "autopilot")
+    assert check[1] == doctor.OK
+    assert "shed_widen" in check[2] and "ladder depth 1" in check[2]
+    assert "cooling: shed" in check[2]
+    # dry-run with pending would-have actions: the loop believes the
+    # fleet needs intervention nobody is applying
+    root["autopilot"].update(mode="dry-run", pendingDryRun=4)
+    checks = doctor.diagnose(_scraped_router(root))
+    check = next(c for c in checks if c[0] == "autopilot")
+    assert check[1] == doctor.WARN
+    assert "4 would-have action(s)" in check[2]
+
+
+def test_doctor_warns_on_cache_ttl_over_foldin_gate():
+    foldin_root = {"status": 200,
+                   "body": json.dumps({"status": "alive",
+                                       "foldin": {"enabled": True}})}
+    plain_root = {"status": 200,
+                  "body": json.dumps({"status": "alive"})}
+    root = _base_root(cache={"enabled": True, "ttlMs": 5000.0,
+                             "hits": 0, "misses": 0, "entries": 0,
+                             "hitRatio": 0.0})
+    scraped = _scraped_router(root)
+    scraped["backendRoots"] = [foldin_root]
+    check = next(c for c in doctor.diagnose(scraped)
+                 if c[0] == "router-cache")
+    assert check[1] == doctor.WARN
+    assert "fold-in" in check[2] and "KNOWN_ISSUES #17" in check[2]
+    # TTL at the gate: fine
+    root["cache"]["ttlMs"] = 2000.0
+    scraped = _scraped_router(root)
+    scraped["backendRoots"] = [foldin_root]
+    check = next(c for c in doctor.diagnose(scraped)
+                 if c[0] == "router-cache")
+    assert check[1] == doctor.OK
+    # no fold-in behind the cache: no row at all (parity)
+    root["cache"]["ttlMs"] = 60000.0
+    scraped = _scraped_router(root)
+    scraped["backendRoots"] = [plain_root]
+    assert not [c for c in doctor.diagnose(scraped)
+                if c[0] == "router-cache"]

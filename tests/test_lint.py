@@ -379,6 +379,40 @@ def test_declarations_pass_fires_on_undeclared_category_in_realtime():
         readme_text="") if f.rule == "journal-undeclared"]
 
 
+def test_declarations_pass_covers_autopilot_families():
+    """ISSUE 18 seeded defect: the autopilot subsystem sits inside the
+    declarations triangle like every other — an undeclared
+    pio_autopilot_* metric fires exactly one finding, while the real
+    autopilot metrics, PIO_AUTOPILOT_* knobs, and the `autopilot`
+    journal category all pass."""
+    bad = ("from predictionio_tpu.common import telemetry\n"
+           "c = telemetry.registry().counter(\n"
+           "    'pio_autopilot_bogus_total', 'x',\n"
+           "    labelnames=('action',))\n")
+    found = [f for f in declarations.run(
+        [_mod(bad, rel="predictionio_tpu/workflow/autopilot.py")],
+        readme_text="") if f.rule == "metric-undeclared"]
+    assert len(found) == 1
+    assert "pio_autopilot_bogus_total" in found[0].message
+
+    ok = ("import os\n"
+          "from predictionio_tpu.common import journal, telemetry\n"
+          "a = os.environ.get('PIO_AUTOPILOT_COOLDOWN_S', '30')\n"
+          "b = os.environ.get('PIO_AUTOPILOT_UTIL_HIGH', '0.85')\n"
+          "reg = telemetry.registry()\n"
+          "reg.counter('pio_autopilot_actions_total', 'x',\n"
+          "            labelnames=('action', 'outcome'))\n"
+          "reg.gauge('pio_autopilot_state', 'x')\n"
+          "reg.gauge('pio_autopilot_last_action_age_seconds', 'x')\n"
+          "journal.emit('autopilot', 'shed widened',\n"
+          "             level=journal.WARN)\n")
+    found = declarations.run(
+        [_mod(ok, rel="predictionio_tpu/workflow/autopilot.py")],
+        readme_text="")
+    assert not [f for f in found if f.rule in (
+        "metric-undeclared", "env-undeclared", "journal-undeclared")]
+
+
 def test_declarations_pass_clean_on_real_repo_and_readme():
     """Every PIO_* read, pio_* metric, and journal.emit category in the
     real tree is declared in common/declarations.py and (env/metric)

@@ -111,6 +111,56 @@ def test_idle_windows_burn_zero(fresh_registry):
         assert v[s]["budget_remaining"] == 1.0
 
 
+def test_burn_crossing_journal_full_recovery_cycle(fresh_registry):
+    """The full cross-up -> sustain -> cross-down cycle journals edges
+    ONLY: one event when a window goes hot, silence while it stays hot,
+    one recovery event when it subsides. The autopilot's ladder (and a
+    paged human) both key off these edges — a per-scrape repeat would
+    re-trigger every cooldown."""
+    from predictionio_tpu.common import journal
+    journal.clear()
+    eng = slo.SLOEngine(slo.SLOConfig(availability=0.999,
+                                      fast_window_s=60.0,
+                                      slow_window_s=600.0))
+    c_ok = _http_counter().labels(service="RC", status="200")
+    c_bad = _http_counter().labels(service="RC", status="500")
+    c_ok.inc(1000)
+    eng.evaluate(now=0.0)                       # baseline snapshot
+    # 5% failures = 50x the 0.1% allowance: both windows cross up
+    c_ok.inc(950)
+    c_bad.inc(50)
+    eng.evaluate(now=100.0)
+    ev = journal.snapshot(category="slo")["events"]
+    reds = [e for e in ev if e["level"] == "red"]
+    warns = [e for e in ev if e["level"] == "warn"]
+    assert len(reds) == 1
+    assert "burn rate" in reds[0]["message"]
+    assert "over the fast window" in reds[0]["message"]
+    assert len(warns) == 1
+    assert "over the slow window" in warns[0]["message"]
+    # sustained burn: another hot evaluate emits NOTHING new
+    c_ok.inc(950)
+    c_bad.inc(50)
+    eng.evaluate(now=130.0)
+    assert len(journal.snapshot(category="slo")["events"]) == 2
+    # recovery: a long clean stretch pushes the errors out of both
+    # windows -> exactly one subsided event per window, INFO
+    c_ok.inc(5000)
+    eng.evaluate(now=800.0)
+    ev = journal.snapshot(category="slo")["events"]
+    subsided = [e for e in ev if "burn subsided" in e["message"]]
+    assert len(subsided) == 2
+    assert all(e["level"] == "info" for e in subsided)
+    assert {("fast-window" in e["message"], "slow-window" in e["message"])
+            for e in subsided} == {(True, False), (False, True)}
+    # and the cycle is re-armed: a NEW burst crosses up again
+    c_ok.inc(950)
+    c_bad.inc(50)
+    eng.evaluate(now=900.0)
+    ev = journal.snapshot(category="slo")["events"]
+    assert sum(e["level"] == "red" for e in ev) == 2
+
+
 # ---------------------------------------------------------------------------
 # collector + wire parity
 # ---------------------------------------------------------------------------
