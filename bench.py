@@ -2516,6 +2516,267 @@ def measure_autopilot(n_conns: int = 4, queries_per_client: int = 200,
     return out
 
 
+def measure_autotrain(n_conns: int = 3, volume_events: int = 8):
+    """Continuous-training leg (workflow/autotrain.py): two chapters
+    against an embedded deploy on the leg's OWN storage (its extra
+    COMPLETED instances must never become the bench storage's latest
+    and change what the serving legs deploy).
+
+    **Accept cycle** — a live event burst crosses the volume trigger
+    while client threads pump /queries.json over the wire and the
+    fold-in worker runs; the loop launches a REAL retrain (run_train on
+    a thread), validates the candidate against the live generation
+    (score tolerance + ranking-parity probe on a deterministic probe
+    set), and publishes through the in-place swap. Records
+    ``autotrain_cycle_s`` (trigger decision -> new generation live);
+    the burst must see zero dropped queries and the generation must
+    bump exactly once. Cycle completion + zero-drops gate on >= 4-core
+    hosts under BENCH_STRICT_EXTRAS=1 (``autotrain_gate_capable``
+    records the honest skip — the retrain compiles jax on one shared
+    core otherwise and the wall clock measures the host).
+
+    **Reject cycle** — a seeded provably-worse candidate (user factors
+    negated: every ranking inverts) goes through the SAME validate
+    path: it must be REJECTED with evidence, its ledger row flipped so
+    no resolve ever deploys it, and the prior generation kept serving
+    with no publish. Gated on every host — the verdict is in-process
+    arithmetic, not a timing race."""
+    import datetime as _dt
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.common import journal
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.data.api.http import serve_background
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import (
+        App, EngineInstance, Model, Storage,
+    )
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from predictionio_tpu.workflow import model_io, run_train
+    from predictionio_tpu.workflow.autotrain import (
+        Autotrain, AutotrainConfig, LocalDeployControl, ThreadTrainer,
+        Trainer,
+    )
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.create_server import (
+        QueryAPI, ServerConfig,
+    )
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    capable = cores >= 4
+    out: dict = {"autotrain_gate_capable": capable}
+    app_name = "AutotrainBench"
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    app_id = storage.get_meta_data_apps().insert(App(0, app_name))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(41)
+
+    def rate_events(month):
+        events = []
+        for u in range(64):
+            for i in rng.choice(48, size=12, replace=False).tolist():
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": float(1 + (u * 7 + i) % 5)}),
+                    event_time=_dt.datetime(
+                        2021, month, 1, tzinfo=_dt.timezone.utc)))
+        return events
+
+    storage.get_events().insert_batch(rate_events(1), app_id)
+    params_json = {
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 8, "numIterations": 3, "lambda": 0.05,
+            "seed": 44}}]}
+    run_train(
+        WorkflowContext(storage=storage), RecommendationEngine(),
+        EngineParams(
+            data_source_params=DataSourceParams(appName=app_name),
+            algorithm_params_list=(("als", ALSAlgorithmParams(
+                rank=8, numIterations=3, lambda_=0.05, seed=44)),)),
+        engine_factory=("predictionio_tpu.models.recommendation"
+                        ":RecommendationEngine"),
+        params_json=params_json)
+
+    cursor_dir = tempfile.mkdtemp(prefix="pio_autotrain_cursor_")
+    prev_env = {k: os.environ.get(k) for k in
+                ("PIO_FOLDIN", "PIO_FOLDIN_CURSOR_DIR")}
+    os.environ["PIO_FOLDIN_CURSOR_DIR"] = cursor_dir
+    os.environ.pop("PIO_FOLDIN", None)
+    api = server = at = None
+    try:
+        api = QueryAPI(storage=storage, engine=RecommendationEngine(),
+                       config=ServerConfig(batching="on", foldin="on",
+                                           foldin_tick_ms=20.0,
+                                           foldin_headroom=16))
+        server, port = serve_background(api)
+        gen_before = api.generation
+        live_before = api.engine_instance.id
+
+        def _retrain() -> str:
+            return run_train(
+                api.ctx, api.engine, api.engine_params,
+                engine_factory=("predictionio_tpu.models."
+                                "recommendation:RecommendationEngine"),
+                params_json=params_json)
+
+        cfg = AutotrainConfig(
+            poll_ms=50.0, cooldown_s=60.0, max_staleness_s=86400.0,
+            volume_events=volume_events, lag_events=100_000,
+            tolerance=0.05, parity_min=0.2, probe=64,
+            publish_timeout_s=60.0)
+        at = Autotrain(LocalDeployControl(api), storage=storage,
+                       engine_params=api.engine_params,
+                       trainer=ThreadTrainer(_retrain), config=cfg)
+        api.attach_autotrain(at)
+
+        # ---- accept cycle: burst -> volume trigger -> publish --------
+        burst_errors: list = []
+        stop = threading.Event()
+
+        def burst(cx):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.connect()
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while not stop.is_set():
+                    conn.request(
+                        "POST", "/queries.json",
+                        body=json.dumps({"user": f"u{cx}", "num": 10}),
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    if resp.status != 200:   # a dropped query IS a failure
+                        burst_errors.append(payload[:200])
+                        return
+                conn.close()
+            except Exception as e:
+                burst_errors.append(f"{type(e).__name__}: {e}")
+
+        clients = [threading.Thread(target=burst, args=(cx,))
+                   for cx in range(n_conns)]
+        for t in clients:
+            t.start()
+        t_trigger = None
+        cycle_s = None
+        try:
+            # the live burst that crosses the volume trigger
+            storage.get_events().insert_batch(rate_events(2), app_id)
+            decided = False
+            deadline = time.perf_counter() + 180.0
+            while time.perf_counter() < deadline:
+                at.tick(at.gather())
+                if not decided and at._phase != "idle":
+                    decided = True
+                    t_trigger = time.perf_counter()
+                if decided and at._phase == "idle":
+                    cycle_s = time.perf_counter() - t_trigger
+                    break
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in clients:
+                t.join(timeout=30.0)
+        s = at.summary()
+        published = bool(s.get("lastCycle")) and api.generation \
+            == gen_before + 1 and api.engine_instance.id != live_before
+        out["autotrain_cycle_s"] = (
+            round((s.get("lastCycle") or {}).get("cycleS", cycle_s)
+                  or 0.0, 2) if published else None)
+        out["autotrain_published"] = published
+        out["autotrain_zero_drops"] = not burst_errors
+        if burst_errors:
+            out["autotrain_burst_error"] = str(burst_errors[0])
+        out["autotrain_generation"] = api.generation
+
+        # ---- reject cycle: seeded provably-worse candidate ----------
+        live = api.engine_instance.id
+        instances = storage.get_meta_data_engine_instances()
+        models = model_io.deserialize_models(
+            storage.get_model_data_models().get(live).models)
+        models[0].user_factors = -np.asarray(
+            models[0].user_factors, np.float32)
+        cand = instances.insert(EngineInstance(
+            **{**instances.get(live).__dict__,
+               "id": "", "status": "COMPLETED"}))
+        storage.get_model_data_models().insert(Model(
+            id=cand, models=model_io.serialize_models(models)))
+
+        class _SeededTrainer(Trainer):
+            """Hands the state machine the pre-seeded candidate —
+            the validate/reject path under test is downstream."""
+
+            def start(self):
+                pass
+
+            def running(self):
+                return False
+
+            def poll(self):
+                return {"ok": True, "instanceId": cand}
+
+            def close(self):
+                pass
+
+        from predictionio_tpu.workflow.autotrain import Signals
+        at2 = Autotrain(LocalDeployControl(api), storage=storage,
+                        engine_params=api.engine_params,
+                        trainer=_SeededTrainer(), config=cfg)
+        at2._live_id = live
+        gen_mid = api.generation
+        at2.tick(Signals(now=time.monotonic(), staleness_s=1e9,
+                         live_instance_id=live))
+        deadline = time.perf_counter() + 60.0
+        while at2._phase != "idle" and time.perf_counter() < deadline:
+            at2.tick(Signals(now=time.monotonic()))
+            time.sleep(0.02)
+        at2.close()
+        rejected = int(at2.summary()["candidatesRejected"])
+        row = instances.get(cand)
+        out["autotrain_candidates_rejected"] = rejected
+        out["autotrain_reject_ok"] = bool(
+            rejected == 1 and row is not None
+            and row.status == "REJECTED"
+            and api.generation == gen_mid
+            and api.engine_instance.id == live
+            and instances.get_latest_completed(
+                at2.engine_id, at2.engine_version,
+                at2.engine_variant).id != cand)
+        out["autotrain_journaled_events"] = len(
+            journal.snapshot(category="autotrain")["events"])
+    finally:
+        if at is not None:
+            at.close()
+        if server is not None:
+            server.shutdown()
+        if api is not None:
+            api.close()
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(cursor_dir, ignore_errors=True)
+    return out
+
+
 def measure_multitenant(n_conns: int = 6, queries_per_client: int = 50,
                         flood_threads: int = 4):
     """Multi-tenant serving leg (serving/registry.py + the --engines
@@ -3354,6 +3615,21 @@ def main() -> None:
                 autopilot_leg = {"autopilot_error":
                                  f"{type(e).__name__}: {e}"}
 
+        # continuous-training leg (workflow/autotrain.py): a live event
+        # burst crosses the volume trigger under a query burst — real
+        # retrain, validated, published in-place with zero drops and a
+        # generation bump (strict on >= 4-core hosts;
+        # autotrain_gate_capable records the honest skip) plus the
+        # seeded-worse candidate REJECTED with the prior generation
+        # kept serving (strict everywhere — in-process arithmetic)
+        autotrain_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                autotrain_leg = measure_autotrain()
+            except Exception as e:
+                autotrain_leg = {"autotrain_error":
+                                 f"{type(e).__name__}: {e}"}
+
         # multi-tenant leg (serving/registry.py): one process, N engine
         # instances — shared-AOT compile flatness (strict everywhere)
         # and noisy-neighbor p99 isolation (strict on >= 4-core hosts;
@@ -3527,6 +3803,7 @@ def main() -> None:
                 **(partition_leg or {}),
                 **(cache_leg or {}),
                 **(autopilot_leg or {}),
+                **(autotrain_leg or {}),
                 **(mt_leg or {}),
                 **(recompile_watch or {}),
                 **(stream_leg or {}),
@@ -3848,6 +4125,40 @@ def main() -> None:
                             "client burst saw failures during the "
                             "autopilot chaos leg ("
                             f"{autopilot_leg.get('autopilot_burst_error')}"
+                            ") with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and autotrain_leg:
+            if autotrain_leg.get("autotrain_error"):
+                failures.append(
+                    "autotrain leg crashed "
+                    f"({autotrain_leg['autotrain_error']}) with "
+                    "BENCH_STRICT_EXTRAS=1")
+            else:
+                # the reject verdict is in-process arithmetic — gated
+                # on every host: a seeded provably-worse candidate
+                # must never reach the serving path
+                if not autotrain_leg.get("autotrain_reject_ok"):
+                    failures.append(
+                        "autotrain validation did not reject the "
+                        "seeded-worse candidate and keep the prior "
+                        "generation serving (rejected="
+                        f"{autotrain_leg.get('autotrain_candidates_rejected')}"
+                        ") with BENCH_STRICT_EXTRAS=1")
+                # the full live cycle needs cores for the retrain to
+                # run off the burst's CPUs (autotrain_gate_capable
+                # False says why the gate is skipped)
+                if autotrain_leg.get("autotrain_gate_capable"):
+                    if not autotrain_leg.get("autotrain_published"):
+                        failures.append(
+                            "autotrain did not publish a validated "
+                            "candidate within the leg deadline "
+                            "(cycle_s="
+                            f"{autotrain_leg.get('autotrain_cycle_s')}"
+                            ") with BENCH_STRICT_EXTRAS=1")
+                    if not autotrain_leg.get("autotrain_zero_drops"):
+                        failures.append(
+                            "client burst saw dropped queries during "
+                            "the autotrain publish cycle ("
+                            f"{autotrain_leg.get('autotrain_burst_error')}"
                             ") with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and mt_leg:
             if mt_leg.get("multitenant_error"):

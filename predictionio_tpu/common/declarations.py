@@ -194,6 +194,10 @@ ENV_VARS: Dict[str, str] = {
     "PIO_FOLDIN_DRIFT_RECALL_MIN":
         "recall@10 floor below which the fold-in drift probe verdict "
         "is FAILED (journal WARN + doctor WARN; default 0.99)",
+    "PIO_FOLDIN_ITEM_HEADROOM":
+        "item-row capacity pre-padded at model load for fold-in of "
+        "unseen ITEMS (default 1024); exhaustion falls back to the "
+        "/reload hot-swap like the user side",
     # --------------------------------------------------------------- AOT
     "PIO_AOT":
         "ahead-of-time serving compilation: 1/0 overrides "
@@ -378,6 +382,34 @@ ENV_VARS: Dict[str, str] = {
     "PIO_AUTOPILOT_PROFILE_MS":
         "length of the one profile capture the autopilot triggers per "
         "sustained-burn episode (default 2000)",
+    # ----------------------------------------------------------- autotrain
+    "PIO_AUTOTRAIN_POLL_MS":
+        "autotrain control-loop cadence in ms (default 1000)",
+    "PIO_AUTOTRAIN_COOLDOWN_S":
+        "per-trigger-class rate limit: one retrain decision per class "
+        "(drift / lag / volume / staleness) per this many seconds "
+        "(default 600)",
+    "PIO_AUTOTRAIN_MAX_STALENESS_S":
+        "wall-clock trigger: retrain when the live model's training "
+        "run finished longer ago than this (default 86400)",
+    "PIO_AUTOTRAIN_VOLUME_EVENTS":
+        "volume trigger: retrain once this many events accumulate "
+        "past the live model's recorded training cursor (default 5000)",
+    "PIO_AUTOTRAIN_LAG_EVENTS":
+        "lag trigger: retrain when the fold-in tail's cursor lag "
+        "reaches this many events (default 5000)",
+    "PIO_AUTOTRAIN_TOLERANCE":
+        "score gate: a candidate's probe RMSE may exceed the live "
+        "generation's by at most this fraction (default 0.02)",
+    "PIO_AUTOTRAIN_PARITY_MIN":
+        "parity gate: candidate-vs-live ranking recall@10 floor over "
+        "the common vocabulary (default 0.2)",
+    "PIO_AUTOTRAIN_PROBE":
+        "deterministic validation probe size — events for the score "
+        "gate, sampled users for the parity gate (default 256)",
+    "PIO_AUTOTRAIN_PUBLISH_TIMEOUT_S":
+        "how long a publish may take to advance the served generation "
+        "before the cycle fails (default 300)",
 }
 
 #: every pio_* metric family / collector-emitted series -> one-liner.
@@ -425,6 +457,13 @@ METRICS: Dict[str, str] = {
     "pio_foldin_drift_recall":
         "latest drift-probe recall@10: published fold-in rows vs a "
         "fresh half-step on the same events (KNOWN_ISSUES #13)",
+    "pio_foldin_item_drift_recall":
+        "latest ITEM-side drift-probe recall@10: published folded item "
+        "columns vs a fresh transposed half-step on the same events",
+    "pio_foldin_items_total":
+        "fold-in item outcomes: folded / appended (new item into item "
+        "headroom + vocab growth) / pending (deferred to the next "
+        "tick or reload)",
     "pio_degraded_batches_total":
         "flushes tainted by a failed side-channel lookup",
     "pio_degraded_queries_upper_bound":
@@ -490,6 +529,20 @@ METRICS: Dict[str, str] = {
     "pio_autopilot_last_action_age_seconds":
         "seconds since the autopilot's most recent (or dry-run "
         "would-have) action; 0 until the first",
+    # ----------------------------------------------------------- autotrain
+    "pio_autotrain_decisions_total":
+        "autotrain retrain decisions by trigger (drift / lag / volume "
+        "/ staleness) and outcome (ok / failed / dry_run)",
+    "pio_autotrain_candidates_total":
+        "validated retrain candidates by verdict (accepted / rejected "
+        "/ failed)",
+    "pio_autotrain_state":
+        "control-loop phase (0 idle, 1 retraining, 2 validating, 3 "
+        "publishing); -1 while holding off under generation skew or a "
+        "reload barrier",
+    "pio_autotrain_last_decision_age_seconds":
+        "seconds since autotrain's most recent (or dry-run would-have) "
+        "retrain decision; 0 until the first",
     # ----------------------------------------------------------- transport
     "pio_http_requests_total": "HTTP requests by path/code",
     "pio_http_request_seconds": "HTTP request handling latency",
@@ -610,6 +663,12 @@ JOURNAL_CATEGORIES: Dict[str, str] = {
         "ladder), quarantine/readmit, profile captures, hold-offs "
         "under generation skew, and dry-run would-have actions "
         "(workflow/autopilot.py)",
+    "autotrain":
+        "continuous-training decisions with their triggering evidence "
+        "(drift / cursor lag / event volume / staleness), retrain "
+        "crash-resumes, candidate validation verdicts (rejections keep "
+        "the prior generation serving), barrier publishes, hold-offs, "
+        "and dry-run would-have decisions (workflow/autotrain.py)",
 }
 
 

@@ -573,10 +573,17 @@ class ALSAlgorithm(Algorithm):
             vals, idx = jax.device_get(topk.topk_for_user(
                 model.user_factors, model.item_factors,
                 np.int32(user_ix), k=k))
+        # fold-in headroom guard: with item fold-in on, the item matrix
+        # carries zero pad rows past the vocab (realtime/foldin.py
+        # pad_capacity) that are unmasked in the replicated layouts and
+        # can surface when k reaches the catalog size — drop any index
+        # past the registered vocab (a no-op when fold-in is off: the
+        # matrix row count equals the vocab size)
+        n_real = len(model.item_vocab)
         inv = model.item_vocab.inverse()
         return PredictedResult(tuple(
             ItemScore(item=inv(int(i)), score=float(s))
-            for s, i in zip(vals, idx)))
+            for s, i in zip(vals, idx) if int(i) < n_real))
 
     def predict_batch(self, model: ALSModel,
                       queries) -> List[PredictedResult]:
@@ -669,11 +676,14 @@ class ALSAlgorithm(Algorithm):
                     model.user_factors, model.item_factors, pix, k=k))
             rows = [(vals[r, :min(q.num, k)], idx[r, :min(q.num, k)])
                     for r, (_qx, q, _ix) in enumerate(valid)]
+        # same fold-in headroom guard as predict(): pad rows past the
+        # item vocab never surface in a result
+        n_real = len(model.item_vocab)
         inv = model.item_vocab.inverse()
         for (qx, _q, _ix), (rvals, ridx) in zip(valid, rows):
             out[qx] = PredictedResult(tuple(
                 ItemScore(item=inv(int(i)), score=float(s))
-                for s, i in zip(rvals, ridx)))
+                for s, i in zip(rvals, ridx) if int(i) < n_real))
         return out
 
     def batch_predict(self, model: ALSModel,
@@ -697,10 +707,12 @@ class ALSAlgorithm(Algorithm):
         ixs = np.asarray([ix for _qx, _q, ix in valid], dtype=np.int32)
         vals, idx = topk.topk_scores_batch(U[ixs], model.item_factors, k=k)
         vals, idx = np.asarray(vals), np.asarray(idx)
+        n_real = len(model.item_vocab)   # fold-in headroom guard
         inv = model.item_vocab.inverse()
         for row, (qx, q, _ix) in enumerate(valid):
             n = max(min(q.num, k), 0)   # a negative num is empty, not top-n
             out.append((qx, PredictedResult(tuple(
                 ItemScore(item=inv(int(i)), score=float(s))
-                for s, i in zip(vals[row, :n], idx[row, :n])))))
+                for s, i in zip(vals[row, :n], idx[row, :n])
+                if int(i) < n_real))))
         return out

@@ -169,6 +169,62 @@ def ranking_parity(user_factors, item_factors, qf: QuantizedFactors,
     }
 
 
+def ranking_agreement(user_factors_a, item_factors_a,
+                      user_factors_b, item_factors_b,
+                      k: int = 10, sample: int = 256,
+                      user_map: Optional[np.ndarray] = None,
+                      item_map: Optional[np.ndarray] = None
+                      ) -> Dict[str, Any]:
+    """recall@k and exact-match@1 of factor pair B's ranking against
+    factor pair A's, on the same deterministic evenly-spaced user
+    sample and stable tie rule as :func:`ranking_parity` — the probe
+    generalized from "quantized vs fp32 of ONE model" to "any two
+    models over a common vocabulary" (autotrain validates a retrain
+    candidate against the live generation with it).
+
+    ``user_map``/``item_map`` align B's index space to A's: entry i is
+    B's index for A's user/item i (identity when omitted — same
+    vocabulary). B's top-k indices are mapped back into A's item space
+    before the overlap is scored, so the figure reads "of A's top k,
+    how many does B also rank top k"."""
+    Ua = np.asarray(user_factors_a, np.float32)
+    Va = np.asarray(item_factors_a, np.float32)
+    Ub = np.asarray(user_factors_b, np.float32)
+    Vb = np.asarray(item_factors_b, np.float32)
+    n_users = Ua.shape[0]
+    if user_map is None:
+        user_map = np.arange(min(n_users, Ub.shape[0]), dtype=np.int64)
+    else:
+        user_map = np.asarray(user_map, np.int64)
+    if item_map is None:
+        item_map = np.arange(min(Va.shape[0], Vb.shape[0]),
+                             dtype=np.int64)
+    else:
+        item_map = np.asarray(item_map, np.int64)
+    n_common_users = int(user_map.shape[0])
+    n_common_items = int(item_map.shape[0])
+    if n_common_users == 0 or n_common_items == 0:
+        return {"k": 0, "sampledUsers": 0, "commonItems": 0,
+                "recall": 0.0, "exact1": 0.0}
+    k = min(int(k), n_common_items)
+    take = min(int(sample), n_common_users)
+    pick = np.unique(np.linspace(0, n_common_users - 1,
+                                 take).astype(np.int64))
+    sa = Ua[pick] @ Va[item_map].T
+    sb = Ub[user_map[pick]] @ Vb[item_map].T
+    top_a = np.argsort(-sa, axis=1, kind="stable")[:, :k]
+    top_b = np.argsort(-sb, axis=1, kind="stable")[:, :k]
+    inter = np.asarray([np.intersect1d(a, b).size
+                        for a, b in zip(top_a, top_b)])
+    return {
+        "k": k,
+        "sampledUsers": int(pick.size),
+        "commonItems": n_common_items,
+        "recall": float(np.mean(inter / max(k, 1))),
+        "exact1": float(np.mean(top_a[:, 0] == top_b[:, 0])),
+    }
+
+
 def recall_floor() -> float:
     """The recall@k below which "auto" mode refuses to quantize
     (``PIO_SERVE_QUANT_RECALL_MIN``, default 0.99 — the KNOWN_ISSUES
@@ -323,6 +379,25 @@ def scatter_user_rows_quant(
     return u_q.at[ixs].set(q_rows), u_scale.at[ixs].set(scales)
 
 
+@jax.jit
+def scatter_item_cols_quant(
+    vt_q: jnp.ndarray,       # (r, n_pad) int8, device — items TRANSPOSED
+    v_scale: jnp.ndarray,    # (n_pad,) fp32, device
+    ixs: jnp.ndarray,        # (b,) int32 item columns to replace
+    q_rows: jnp.ndarray,     # (b, r) int8 replacement item rows
+    scales: jnp.ndarray,     # (b,) fp32 replacement per-item scales
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Item fold-in publication scatter for the replicated quantized
+    layout: the item matrix serves TRANSPOSED, so folded item rows land
+    as COLUMNS of ``vt_q`` plus their per-item scales, in one dispatch.
+    Same contract as :func:`scatter_user_rows_quant`: in-bounds ``ixs``
+    (item capacity bookkeeping), duplicate indices carry identical
+    rows, and the caller swaps a rebuilt QuantizedServing in one atomic
+    reference assignment."""
+    return (vt_q.at[:, ixs].set(q_rows.T.astype(vt_q.dtype)),
+            v_scale.at[ixs].set(scales))
+
+
 @partial(jax.jit, static_argnames=("k", "n_items"))
 def topk_for_user_quant(
     u_q: jnp.ndarray,        # (n_users, r) int8
@@ -431,6 +506,19 @@ class QuantizedServing:
         new_q, new_s = scatter_user_rows_quant(
             self.u_q, self.u_scale, ixs, q_rows, scales)
         return dataclasses.replace(self, u_q=new_q, u_scale=new_s)
+
+    def apply_item_rows(self, ixs, rows_fp32) -> "QuantizedServing":
+        """The item-side twin of :meth:`apply_user_rows`: ``rows_fp32``
+        re-quantized per-row and scattered as COLUMNS of the transposed
+        item layout at ``ixs`` (item fold-in publishes into the item
+        headroom the deploy pre-padded; ``n_items`` is that padded
+        count, so the statics — and the prebuilt programs — never
+        change). Same one-atomic-swap publication contract."""
+        ixs = np.asarray(ixs, dtype=np.int32)
+        q_rows, scales = quantize_rows(np.asarray(rows_fp32, np.float32))
+        new_vt, new_s = scatter_item_cols_quant(
+            self.vt_q, self.v_scale, ixs, q_rows, scales)
+        return dataclasses.replace(self, vt_q=new_vt, v_scale=new_s)
 
     def int8_bytes(self) -> int:
         """Logical serving footprint (int8 matrices + fp32 scales; same
@@ -573,6 +661,33 @@ def _scatter_primer(qs: QuantizedServing, bucket: int):
     return prime
 
 
+def scatter_item_program_specs(qs: QuantizedServing,
+                               buckets: Iterable[int]) -> List[Any]:
+    """Item-side twin of :func:`scatter_program_specs`: one ProgramSpec
+    per publication bucket for the transposed item-column scatter the
+    realtime layer dispatches when items fold in."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    n_pad = int(np.shape(qs.vt_q)[1])
+    out: List[Any] = []
+    for b in sorted({int(x) for x in buckets}):
+        out.append(ProgramSpec(
+            name="scatter_item_cols_quant",
+            key=("scatter_item_cols_quant", n_pad, qs.rank, int(b)),
+            prime=_item_scatter_primer(qs, int(b))))
+    return out
+
+
+def _item_scatter_primer(qs: QuantizedServing, bucket: int):
+    def prime():
+        ix = np.zeros((bucket,), dtype=np.int32)
+        q_rows, scales = quantize_rows(
+            np.zeros((bucket, qs.rank), dtype=np.float32))
+        jax.device_get(scatter_item_cols_quant(
+            qs.vt_q, qs.v_scale, ix, q_rows, scales)[1][:1])
+    return prime
+
+
 # ---------------------------------------------------------------------------
 # deploy-state surface: GET / "quant" section, gauges, /debug/device.json
 # ---------------------------------------------------------------------------
@@ -656,6 +771,13 @@ def _register() -> None:
         note="fold-in publication scatter for the replicated int8 "
              "layout (realtime/foldin.py); enumerated per publication "
              "bucket by scatter_program_specs on fold-in deploys")
+    aot.register_jit(
+        "scatter_item_cols_quant", scatter_item_cols_quant,
+        kind="serving",
+        note="item fold-in publication scatter for the replicated int8 "
+             "layout's transposed item matrix (realtime/foldin.py); "
+             "enumerated per publication bucket by "
+             "scatter_item_program_specs on fold-in deploys")
 
 
 _register()

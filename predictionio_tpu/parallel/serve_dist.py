@@ -501,6 +501,34 @@ class ShardedFactors:
             self.user_shards, ixs, rows, mesh=self.mesh)
         return dataclasses.replace(self, user_shards=new_u)
 
+    @property
+    def item_capacity(self) -> int:
+        """Padded item-row capacity (rows_dev_i * n_dev): the headroom
+        the realtime fold-in layer appends new items into."""
+        return int(self.rows_dev_i) * self.n_shards
+
+    def apply_item_rows(self, ixs, rows_fp32) -> "ShardedFactors":
+        """Item-side twin of :meth:`apply_user_rows`: scatter folded
+        ITEM rows into the sharded item matrix (user shards unchanged —
+        the transposed fold-in half-step holds the user matrix fixed).
+        The scatter kernels are shape-generic functional updates, so
+        the item side rides the SAME jitted programs with the item
+        shapes — no new kernels, just new (shape, bucket) entries in
+        the AOT registry via scatter_item_program_specs."""
+        ixs = np.asarray(ixs, dtype=np.int32)
+        rows = np.asarray(rows_fp32, dtype=np.float32)
+        if self.dtype == "int8":
+            from predictionio_tpu.ops.quant import quantize_rows
+            q_rows, scales = quantize_rows(rows)
+            new_q, new_s = scatter_user_rows_sharded_quant(
+                self.item_shards, self.item_scales, ixs, q_rows, scales,
+                mesh=self.mesh)
+            return dataclasses.replace(
+                self, item_shards=new_q, item_scales=new_s)
+        new_v = scatter_user_rows_sharded(
+            self.item_shards, ixs, rows, mesh=self.mesh)
+        return dataclasses.replace(self, item_shards=new_v)
+
     def summary(self) -> Dict[str, Any]:
         out = {
             "shards": self.n_shards,
@@ -713,6 +741,45 @@ def _scatter_primer(sharded: ShardedFactors, bucket: int):
             rows = np.broadcast_to(rows, (bucket, sharded.rank)).copy()
             jax.device_get(scatter_user_rows_sharded(
                 sharded.user_shards, ix, rows, mesh=sharded.mesh)[:1])
+    return prime
+
+
+def scatter_item_program_specs(sharded: ShardedFactors,
+                               buckets: Iterable[int]) -> List[Any]:
+    """Item-side twin of :func:`scatter_program_specs`: the SAME
+    shape-generic scatter kernels dispatched with the item-shard
+    shapes, so item fold-in publication also compiles nothing
+    post-warmup. Distinct registry keys come from the item row count
+    (the kernels are keyed by (name, rows, rank, shards, bucket))."""
+    from predictionio_tpu.serving.aot import ProgramSpec
+
+    name = ("scatter_user_rows_sharded_quant" if sharded.dtype == "int8"
+            else "scatter_user_rows_sharded")
+    out: List[Any] = []
+    for b in sorted({int(x) for x in buckets}):
+        out.append(ProgramSpec(
+            name=name,
+            key=(name, sharded.n_items, sharded.rank,
+                 sharded.n_shards, int(b)),
+            prime=_item_scatter_primer(sharded, int(b))))
+    return out
+
+
+def _item_scatter_primer(sharded: ShardedFactors, bucket: int):
+    def prime():
+        ix = np.zeros((bucket,), dtype=np.int32)
+        if sharded.dtype == "int8":
+            rows = np.zeros((bucket, sharded.rank), dtype=np.float32)
+            from predictionio_tpu.ops.quant import quantize_rows
+            q_rows, scales = quantize_rows(rows)
+            jax.device_get(scatter_user_rows_sharded_quant(
+                sharded.item_shards, sharded.item_scales, ix, q_rows,
+                scales, mesh=sharded.mesh)[1][:1])
+        else:
+            rows = jax.device_get(sharded.item_shards[:1])
+            rows = np.broadcast_to(rows, (bucket, sharded.rank)).copy()
+            jax.device_get(scatter_user_rows_sharded(
+                sharded.item_shards, ix, rows, mesh=sharded.mesh)[:1])
     return prime
 
 

@@ -49,6 +49,19 @@ the speed layer that closes it:
   KNOWN_ISSUES #12/#13) surfaced on ``GET /``, ``/debug/device.json``
   and the `pio doctor` fold-in line.
 
+- **Items too.** The transposed half-step folds UNSEEN items against
+  the fixed user matrix (Sarwar et al.'s fold-in applied to the item
+  side): a new listing's events solve its column from the users who
+  touched it, publish into item-side headroom rows pre-padded at
+  deploy (``PIO_FOLDIN_ITEM_HEADROOM``) across every layout (host
+  fp32, device fp32, sharded, int8 re-quantized per-row), and grow
+  the item vocab — so "new item listed → appears in top-k" no longer
+  waits for a retrain. Trained item rows are never overwritten (the
+  batch solve stays authoritative); only unseen-or-previously-folded
+  items re-solve. A transposed drift probe
+  (``pio_foldin_item_drift_recall``) watches the item side the same
+  way the user probe does.
+
 ``PIO_FOLDIN=0`` (the default; ``pio deploy --foldin`` or
 ``PIO_FOLDIN=1`` opts in) keeps every existing endpoint byte-identical
 — asserted by test, the same wire-parity contract as PIO_AOT/SERVE_*.
@@ -160,6 +173,18 @@ def default_headroom() -> int:
         return 1024
 
 
+def default_item_headroom() -> int:
+    """Item-side capacity pad (``PIO_FOLDIN_ITEM_HEADROOM``, default
+    1024): rows appended to the ITEM matrix at deploy so unseen items
+    fold in without a shape change — the transposed twin of
+    ``PIO_FOLDIN_HEADROOM``."""
+    raw = os.environ.get("PIO_FOLDIN_ITEM_HEADROOM", "")
+    try:
+        return max(int(raw), 0) if raw else 1024
+    except ValueError:
+        return 1024
+
+
 def drift_every() -> int:
     """Ticks between drift probes (``PIO_FOLDIN_DRIFT_EVERY``, default
     64; 0 disables the probe)."""
@@ -200,6 +225,7 @@ class FoldinConfig:
     channel_id: Optional[int] = None
     tick_ms: float = 250.0
     headroom: int = 1024
+    item_headroom: int = 1024
     event_names: Tuple[str, ...] = ("rate", "buy")
     entity_type: str = "user"
     target_entity_type: str = "item"
@@ -213,7 +239,9 @@ class FoldinConfig:
 
 
 def config_for(engine_params: Any, tick_ms: float = 0.0,
-               headroom: Optional[int] = None) -> Optional[FoldinConfig]:
+               headroom: Optional[int] = None,
+               item_headroom: Optional[int] = None
+               ) -> Optional[FoldinConfig]:
     """Derive the worker config from a deployed engine's params: the
     app name from the datasource params, lambda from the first
     algorithm's params, tick cadence from the caller (0 =
@@ -234,6 +262,8 @@ def config_for(engine_params: Any, tick_ms: float = 0.0,
         app_name=str(app_name),
         tick_ms=float(tick_ms) if tick_ms else default_tick_ms(),
         headroom=default_headroom() if headroom is None else int(headroom),
+        item_headroom=(default_item_headroom() if item_headroom is None
+                       else int(item_headroom)),
         lambda_=lam)
 
 
@@ -330,42 +360,52 @@ def _solve_primer(rank: int, bucket: int, nnz_pad: int, reg_scaling: str):
 
 def publication_program_specs(model: Any) -> List[Any]:
     """The layout-appropriate publication scatter programs for this
-    prepared model, one per user bucket: sharded layouts enumerate
-    through serve_dist, replicated int8 through ops.quant, replicated
-    device fp32 here; host-numpy serving publishes with plain row
-    writes and contributes nothing."""
+    prepared model, one per publication bucket and SIDE (user rows +
+    item rows — both halves of the speed layer publish through
+    prebuilt programs): sharded layouts enumerate through serve_dist,
+    replicated int8 through ops.quant, replicated device fp32 here;
+    host-numpy serving publishes with plain row writes and contributes
+    nothing."""
     from predictionio_tpu.serving.aot import ProgramSpec
 
     sharding = getattr(model, "sharding", None)
     if sharding is not None:
         from predictionio_tpu.parallel import serve_dist
-        return serve_dist.scatter_program_specs(sharding, user_buckets())
+        return (serve_dist.scatter_program_specs(sharding, user_buckets())
+                + serve_dist.scatter_item_program_specs(
+                    sharding, user_buckets()))
     quant = getattr(model, "quant", None)
     if quant is not None:
         from predictionio_tpu.ops import quant as quant_mod
-        return quant_mod.scatter_program_specs(quant, user_buckets())
+        return (quant_mod.scatter_program_specs(quant, user_buckets())
+                + quant_mod.scatter_item_program_specs(
+                    quant, user_buckets()))
     U = getattr(model, "user_factors", None)
     if U is None or isinstance(U, np.ndarray):
         return []
-    n_pad, rank = (int(d) for d in np.shape(U))
     out: List[Any] = []
-    for b in user_buckets():
-        out.append(ProgramSpec(
-            name="scatter_user_rows",
-            key=("scatter_user_rows", n_pad, rank, int(b)),
-            prime=_scatter_primer(model, int(b))))
+    for attr in ("user_factors", "item_factors"):
+        arr = getattr(model, attr, None)
+        if arr is None or isinstance(arr, np.ndarray):
+            continue
+        n_pad, rank = (int(d) for d in np.shape(arr))
+        for b in user_buckets():
+            out.append(ProgramSpec(
+                name="scatter_user_rows",
+                key=("scatter_user_rows", n_pad, rank, int(b)),
+                prime=_scatter_primer(model, attr, int(b))))
     return out
 
 
-def _scatter_primer(model: Any, bucket: int):
+def _scatter_primer(model: Any, attr: str, bucket: int):
     def prime():
-        U = model.user_factors
-        rank = int(np.shape(U)[1])
+        M = getattr(model, attr)
+        rank = int(np.shape(M)[1])
         ix = np.zeros((bucket,), dtype=np.int32)
-        rows = jax.device_get(U[:1])
+        rows = jax.device_get(M[:1])
         rows = np.broadcast_to(rows, (bucket, rank)).copy()
         # functional update, result discarded: same program, no state
-        jax.device_get(scatter_user_rows(U, ix, rows)[:1])
+        jax.device_get(scatter_user_rows(M, ix, rows)[:1])
     return prime
 
 
@@ -388,17 +428,26 @@ def program_specs(models: Sequence[Any], prep: Optional[Dict[str, Any]]
 # ---------------------------------------------------------------------------
 
 def pad_capacity(models: Sequence[Any], headroom: int,
-                 algorithms: Sequence[Any] = ()) -> Optional[Dict[str, Any]]:
+                 algorithms: Sequence[Any] = (),
+                 item_headroom: Optional[int] = None
+                 ) -> Optional[Dict[str, Any]]:
     """Append ``headroom`` zero rows to the first ALS-shaped model's
-    user-factor matrix — the capacity new users fold into without a
-    shape change (a resize would recompile every serving program; the
-    pad keeps post-warmup recompiles at 0). Returns the prep record the
-    worker binds against: the model index, a host fp32 copy of the
-    item matrix (the solve's gather source — kept host-side so int8
-    deploys stay free of fp32 device copies), and the trained row
-    count. None when no model is fold-in-shaped. Zero pad rows are
-    harmless everywhere downstream: they score 0, are never indexed
-    until a fold registers the user, and quantize to zeros/scale 1."""
+    user-factor matrix AND ``item_headroom`` zero rows to its item
+    matrix — the capacity new users/items fold into without a shape
+    change (a resize would recompile every serving program; the pad
+    keeps post-warmup recompiles at 0). Returns the prep record the
+    worker binds against: the model index, host fp32 copies of both
+    padded matrices (the solves' gather sources — kept host-side so
+    int8 deploys stay free of fp32 device copies; the item copy is the
+    SAME object assigned to ``model.item_factors``, the user copy the
+    same object as ``model.user_factors``, so host-numpy layouts stay
+    in sync for free), and the trained row counts. None when no model
+    is fold-in-shaped. Zero pad rows are harmless everywhere
+    downstream: they score 0, are never indexed until a fold registers
+    the user/item (serving filters top-k hits past the item vocab),
+    and quantize to zeros/scale 1."""
+    if item_headroom is None:
+        item_headroom = default_item_headroom()
     for i, model in enumerate(models):
         U = getattr(model, "user_factors", None)
         V = getattr(model, "item_factors", None)
@@ -415,6 +464,11 @@ def pad_capacity(models: Sequence[Any], headroom: int,
                            U_host.shape[1]), dtype=np.float32)
         padded[:trained] = U_host
         model.user_factors = padded
+        trained_items = int(V_host.shape[0])
+        v_padded = np.zeros((trained_items + max(int(item_headroom), 0),
+                             V_host.shape[1]), dtype=np.float32)
+        v_padded[:trained_items] = V_host
+        model.item_factors = v_padded
         reg_scaling = "count"
         lam = None
         if i < len(algorithms):
@@ -422,9 +476,12 @@ def pad_capacity(models: Sequence[Any], headroom: int,
                           "lambda_", None)
         return {
             "index": i,
-            "item_factors": V_host,
+            "item_factors": v_padded,
+            "user_factors": padded,
             "trained_users": trained,
+            "trained_items": trained_items,
             "headroom": max(int(headroom), 0),
+            "item_headroom": max(int(item_headroom), 0),
             "reg_scaling": reg_scaling,
             "lambda_": float(lam) if lam is not None else None,
         }
@@ -572,11 +629,15 @@ class CursorStore:
             return None
 
     def save(self, cursor: Any, folded: Sequence[str],
-             pending: Sequence[str]) -> None:
+             pending: Sequence[str],
+             folded_items: Sequence[str] = (),
+             pending_items: Sequence[str] = ()) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"cursor": cursor, "folded": sorted(folded),
-                       "pending": sorted(pending)}, f)
+                       "pending": sorted(pending),
+                       "folded_items": sorted(folded_items),
+                       "pending_items": sorted(pending_items)}, f)
         os.replace(tmp, self.path)
 
 
@@ -619,8 +680,11 @@ class FoldinWorker:
         # model binding (set by bind())
         self._model: Any = None
         self._item_factors: Optional[np.ndarray] = None
+        self._user_factors: Optional[np.ndarray] = None
         self._capacity = 0
+        self._item_capacity = 0
         self._trained_users = 0
+        self._trained_items = 0
         self.generation = 0
         self._reload_cb: Optional[Callable[[], None]] = None
 
@@ -628,22 +692,30 @@ class FoldinWorker:
         self._cursor: Any = None
         self._folded: Dict[str, bool] = {}
         self._pending: Dict[str, bool] = {}
+        self._item_folded: Dict[str, bool] = {}
+        self._item_pending: Dict[str, bool] = {}
         self._ticks = 0
         self._events_seen = 0
         self._events_folded = 0
         self._unknown_items = 0
+        self._unknown_users = 0
         self._last_tick_s = 0.0
         self._last_tick_at = 0.0
         self._last_error = ""
         self._freshness: deque = deque(maxlen=1024)
         self._recent: deque = deque(maxlen=64)   # drift-probe candidates
+        self._recent_items: deque = deque(maxlen=64)
         self._drift: Optional[Dict[str, Any]] = None
+        self._item_drift: Optional[Dict[str, Any]] = None
 
         saved = self._store.load()
         if saved is not None:
             self._cursor = saved.get("cursor")
             for u in saved.get("folded", []) + saved.get("pending", []):
                 self._pending[u] = True
+            for it in (saved.get("folded_items", [])
+                       + saved.get("pending_items", [])):
+                self._item_pending[it] = True
 
         reg = telemetry.registry()
         self._m_fresh = reg.histogram(
@@ -673,6 +745,16 @@ class FoldinWorker:
             "Most recent drift-probe recall@10: published fold-in rows "
             "vs a fresh half-step on the same events (KNOWN_ISSUES #13)"
         ).labels()
+        self._m_items = reg.counter(
+            "pio_foldin_items_total",
+            "Fold-in item outcomes: folded (row updated), appended "
+            "(new item into item headroom), pending (deferred to the "
+            "next tick/reload)", labelnames=("result",))
+        self._m_item_drift = reg.gauge(
+            "pio_foldin_item_drift_recall",
+            "Most recent item drift-probe recall@10: published folded "
+            "item rows vs a fresh transposed half-step on the same "
+            "events (KNOWN_ISSUES #13)").labels()
 
     # ------------------------------------------------------------- binding
     @property
@@ -687,25 +769,41 @@ class FoldinWorker:
             known = len(self._pending) + len(self._folded)
         return max(self.config.headroom, 2 * known)
 
+    def item_headroom_hint(self) -> int:
+        """Item-side twin of :meth:`headroom_hint`."""
+        with self._lock:
+            known = len(self._item_pending) + len(self._item_folded)
+        return max(self.config.item_headroom, 2 * known)
+
     def bind(self, model: Any, generation: int,
              prep: Dict[str, Any],
              reload_cb: Optional[Callable[[], None]] = None) -> None:
         """Point the worker at a freshly prepared model (initial deploy
-        or /reload). Every user folded into the PREVIOUS generation is
-        queued for re-fold — the new generation starts from the trained
-        factors, so fold-in state must be replayed into it."""
+        or /reload). Every user and item folded into the PREVIOUS
+        generation is queued for re-fold — the new generation starts
+        from the trained factors, so fold-in state must be replayed
+        into it."""
         with self._lock:
             for u in self._folded:
                 self._pending[u] = True
             self._folded = {}
+            for it in self._item_folded:
+                self._item_pending[it] = True
+            self._item_folded = {}
             self._model = model
             self._item_factors = np.asarray(prep["item_factors"],
                                             dtype=np.float32)
+            uf = prep.get("user_factors")
+            self._user_factors = (np.asarray(uf, dtype=np.float32)
+                                  if uf is not None else None)
             self._trained_users = int(prep["trained_users"])
+            self._trained_items = int(prep.get("trained_items",
+                                               len(model.item_vocab)))
             self.generation = int(generation)
             self._reload_cb = reload_cb
             self._reload_pending = False
             self._capacity = self._resolve_capacity(model)
+            self._item_capacity = int(self._item_factors.shape[0])
             if self._cursor is None:
                 # first bind ever (no persisted state): training already
                 # consumed everything before the head
@@ -713,11 +811,47 @@ class FoldinWorker:
         journal.emit(
             "foldin",
             (f"fold-in worker bound to generation {generation} "
-             f"({len(self._pending)} user(s) queued for re-fold, "
-             f"capacity {self._capacity})"),
+             f"({len(self._pending)} user(s) and "
+             f"{len(self._item_pending)} item(s) queued for re-fold, "
+             f"capacity {self._capacity}u/{self._item_capacity}i)"),
             level=journal.INFO,
             generation=int(generation), capacity=int(self._capacity),
-            pending=len(self._pending))
+            itemCapacity=int(self._item_capacity),
+            pending=len(self._pending),
+            pendingItems=len(self._item_pending))
+        self._note_state()
+
+    def rebase(self, cursor: Any = None) -> None:
+        """Reset the speed layer onto a NEW batch base: drop every
+        folded/pending user and item and move the cursor to ``cursor``
+        (a retrain's recorded training cursor) or the live head. Called
+        by autotrain after an accepted candidate publishes — the fresh
+        model was trained THROUGH those events, so replaying them would
+        double-apply the speed layer on top of the batch layer. Must
+        run before :meth:`bind` re-points the worker (bind queues
+        folded state for re-fold; rebase declares it absorbed)."""
+        with self._lock:
+            dropped = (len(self._folded) + len(self._pending)
+                       + len(self._item_folded) + len(self._item_pending))
+            self._folded = {}
+            self._pending = {}
+            self._item_folded = {}
+            self._item_pending = {}
+            self._recent.clear()
+            self._recent_items.clear()
+            self._drift = None
+            self._item_drift = None
+            self._reload_pending = False
+            self._cursor = cursor if cursor is not None else (
+                self._tail.head() if self._tail else None)
+            self._persist()
+        journal.emit(
+            "foldin",
+            (f"fold-in rebased onto a new batch base ({dropped} "
+             "folded/pending entr(ies) absorbed by the retrain; cursor "
+             f"{'from training' if cursor is not None else 'at head'})"),
+            level=journal.INFO, dropped=int(dropped),
+            fromTraining=cursor is not None)
         self._note_state()
 
     @staticmethod
@@ -782,19 +916,35 @@ class FoldinWorker:
         # OLDEST unserved event of each user in this window
         acks: Dict[str, float] = {}
         dirty: Dict[str, bool] = {}
-        for uid, _item, _ev, _rat, ack_ts in rows:
+        item_acks: Dict[str, float] = {}
+        dirty_items: Dict[str, bool] = {}
+        item_vocab = self._model.item_vocab
+        for uid, iid, _ev, _rat, ack_ts in rows:
             dirty[uid] = True
             acks[uid] = min(acks.get(uid, ack_ts), ack_ts)
+            # items dirty only when UNSEEN by training or previously
+            # folded: trained rows come from the full batch solve and
+            # must not be overwritten by a single half-step
+            if item_vocab.get(iid) is None or iid in self._item_folded:
+                dirty_items[iid] = True
+                item_acks[iid] = min(item_acks.get(iid, ack_ts), ack_ts)
         for uid in self._pending:
             if uid not in dirty:
                 dirty[uid] = True
-        if not dirty:
+        for iid in self._item_pending:
+            if iid not in dirty_items:
+                dirty_items[iid] = True
+        if not dirty and not dirty_items:
             self._cursor = new_cursor
             self._persist()
             self._finish_tick(t0, lag_only=True)
             self._m_ticks.labels(status="empty").inc()
             return {"folded": 0, "appended": 0, "events": len(rows)}
 
+        # items fold FIRST so a user solve in the same tick gathers the
+        # freshly folded item rows (and resolves the new item's index)
+        i_folded, i_appended, i_deferred = self._fold_items(
+            list(dirty_items), item_acks)
         folded, appended, deferred = self._fold_users(list(dirty), acks)
         self._cursor = new_cursor
         self._persist()
@@ -803,8 +953,11 @@ class FoldinWorker:
         self._m_ticks.labels(status="ok").inc()
         if drift_every() and self._ticks % drift_every() == 0:
             self._drift_probe()
+            self._item_drift_probe()
         out = {"folded": folded, "appended": appended,
-               "deferred": deferred, "events": len(rows)}
+               "deferred": deferred, "events": len(rows),
+               "itemsFolded": i_folded, "itemsAppended": i_appended,
+               "itemsDeferred": i_deferred}
         if self._reload_pending and self._reload_cb is not None:
             # headroom exhausted: generation-coherent fallback to the
             # /reload hot-swap (QueryAPI._load re-pads with our hint
@@ -940,16 +1093,132 @@ class FoldinWorker:
                     self._m_fresh.observe(fresh)
         return folded, appended, deferred
 
-    def _solve(self, rating_lists: List[List[Tuple[int, float]]]
-               ) -> np.ndarray:
-        """Batch half-step for this tick's users (padded onto the
-        smallest declared bucket); returns host (n, r) fp32 rows."""
+    # -------------------------------------------------------- item folding
+    def _gather_item_ratings(self, iid: str,
+                             user_vocab: Any
+                             ) -> Tuple[List[Tuple[int, float]], int]:
+        """The item's full (capped) rating history, user-vocab-encoded
+        — the transposed twin of :meth:`_gather_ratings`: exactly the
+        rows the training item half-step would see for this column
+        (buy → 4.0, most-recent ``PIO_FOLDIN_MAX_EVENTS`` on
+        overflow). Events from users the model does not know yet are
+        counted and skipped — once those users fold in, the item goes
+        dirty again and re-solves with them included."""
+        cfg = self.config
+        evs = list(self._events.find(
+            self.app_id, channel_id=cfg.channel_id,
+            entity_type=cfg.entity_type,
+            event_names=list(cfg.event_names),
+            target_entity_type=cfg.target_entity_type,
+            target_entity_id=iid))
+        evs.sort(key=lambda e: e.event_time)
+        cap = max_events_per_user()
+        if len(evs) > cap:
+            evs = evs[-cap:]
+        out: List[Tuple[int, float]] = []
+        unknown = 0
+        for e in evs:
+            ix = user_vocab.get(e.entity_id)
+            if ix is None:
+                unknown += 1
+                continue
+            if e.event == "buy":
+                rv = cfg.buy_rating
+            else:
+                v = e.properties.get_opt(cfg.rating_property) \
+                    if e.properties else None
+                try:
+                    rv = float(v)
+                except (TypeError, ValueError):
+                    continue
+            out.append((int(ix), rv))
+        return out, unknown
+
+    def _fold_items(self, iids: List[str],
+                    acks: Dict[str, float]) -> Tuple[int, int, int]:
+        """The transposed half of :meth:`_fold_users`: solve each dirty
+        item against the FIXED user matrix and publish the rows into
+        the live item layout. New items append into the item headroom
+        and grow the item vocab (row first, vocab second — a query can
+        rank the new item only once its factors are live)."""
+        if not iids:
+            return 0, 0, 0
+        model = self._model
+        user_vocab = model.user_vocab
+        item_vocab = model.item_vocab
+        buckets = user_buckets()
+        max_batch = buckets[-1]
+
+        work: List[Tuple[str, Optional[int], List[Tuple[int, float]]]] = []
+        for iid in iids:
+            ratings, unknown = self._gather_item_ratings(iid, user_vocab)
+            self._unknown_users += unknown
+            if not ratings:
+                self._item_pending.pop(iid, None)
+                continue
+            work.append((iid, item_vocab.get(iid), ratings))
+
+        folded = appended = deferred = 0
+        for at in range(0, len(work), max_batch):
+            batch = work[at:at + max_batch]
+            entries: List[Tuple[str, int, List[Tuple[int, float]], bool]] \
+                = []
+            next_free = len(item_vocab)
+            for iid, known_ix, ratings in batch:
+                if known_ix is not None:
+                    entries.append((iid, int(known_ix), ratings, False))
+                elif next_free < self._item_capacity:
+                    entries.append((iid, next_free, ratings, True))
+                    next_free += 1
+                else:
+                    # item headroom exhausted: same reload fallback as
+                    # the user side (QueryAPI._load re-pads with
+                    # item_headroom_hint and re-binds)
+                    self._item_pending[iid] = True
+                    self._m_items.labels(result="pending").inc()
+                    self._reload_pending = True
+                    deferred += 1
+            if not entries:
+                continue
+            rows = self._solve(
+                [ratings for _i, _ix, ratings, _new in entries],
+                factors=self._user_factors)
+            pub_ix = np.asarray([ix for _i, ix, _r, _n in entries],
+                                np.int32)
+            self._publish_items(model, pub_ix, rows)
+            now = _wall_now()
+            for (iid, ix, _ratings, is_new), _row in zip(entries, rows):
+                if is_new:
+                    item_vocab.add(iid, int(ix))
+                    appended += 1
+                    self._m_items.labels(result="appended").inc()
+                else:
+                    folded += 1
+                    self._m_items.labels(result="folded").inc()
+                self._item_pending.pop(iid, None)
+                self._item_folded[iid] = True
+                self._recent_items.append(iid)
+                if iid in acks:
+                    fresh = max(now - acks[iid], 0.0)
+                    self._freshness.append(fresh)
+                    self._m_fresh.observe(fresh)
+        return folded, appended, deferred
+
+    def _solve(self, rating_lists: List[List[Tuple[int, float]]],
+               factors: Optional[np.ndarray] = None) -> np.ndarray:
+        """Batch half-step for this tick's users — or, with ``factors``
+        set to the user matrix, the TRANSPOSED half-step for its items
+        (``foldin_solve`` is side-agnostic: the other-side rows arrive
+        pre-gathered, so both sides ride the same compiled programs).
+        Padded onto the smallest declared bucket; returns host (n, r)
+        fp32 rows."""
+        src = self._item_factors if factors is None else factors
         n = len(rating_lists)
         bucket = next((b for b in user_buckets() if b >= n),
                       user_buckets()[-1])
         me = max_events_per_user()
         nnz_pad = bucket * me
-        rank = int(self._item_factors.shape[1])
+        rank = int(src.shape[1])
         item_rows = np.zeros((nnz_pad, rank), np.float32)
         self_idx = np.full((nnz_pad,), bucket, np.int32)
         rating = np.zeros((nnz_pad,), np.float32)
@@ -958,7 +1227,7 @@ class FoldinWorker:
         for j, ratings in enumerate(rating_lists):
             counts[j] = len(ratings)
             for item_ix, rv in ratings:
-                item_rows[pos] = self._item_factors[item_ix]
+                item_rows[pos] = src[item_ix]
                 self_idx[pos] = j
                 rating[pos] = rv
                 pos += 1
@@ -993,6 +1262,13 @@ class FoldinWorker:
         numpy), so a concurrent query sees either the old or the new
         rows — never a torn mix — and none is ever dropped."""
         rows = np.asarray(rows, np.float32)
+        mirror = self._user_factors
+        if mirror is not None and mirror.shape[0] > int(ixs.max()):
+            # host fp32 mirror: the gather source for ITEM solves must
+            # see folded user rows whatever the serving layout (for
+            # host-numpy/quant layouts this aliases model.user_factors,
+            # so the write below is the same write)
+            mirror[ixs] = rows
         sharding = getattr(model, "sharding", None)
         quant = getattr(model, "quant", None)
         if sharding is not None:
@@ -1019,6 +1295,41 @@ class FoldinWorker:
         with devicewatch.attribution("foldin_publish", phase="foldin"):
             model.user_factors = scatter_user_rows(uf, p_ix, p_rows)
 
+    def _publish_items(self, model: Any, ixs: np.ndarray,
+                       rows: np.ndarray) -> None:
+        """Item-side twin of :meth:`_publish` — the same per-layout
+        atomic-swap contract applied to the item matrix: sharded item
+        shards rebuild through the shape-generic sharded scatter, the
+        int8 layout re-quantizes exactly the touched item columns
+        per-row-scale, host numpy writes in place under the GIL, and
+        device fp32 scatters functionally. The worker's host fp32 item
+        mirror (the USER solves' gather source) always updates too."""
+        rows = np.asarray(rows, np.float32)
+        mirror = self._item_factors
+        if mirror is not None and mirror.shape[0] > int(ixs.max()):
+            mirror[ixs] = rows
+        sharding = getattr(model, "sharding", None)
+        quant = getattr(model, "quant", None)
+        if sharding is not None:
+            p_ix, p_rows = self._pub_pad(ixs, rows)
+            with devicewatch.attribution("foldin_publish", phase="foldin"):
+                new = sharding.apply_item_rows(p_ix, p_rows)
+            model.item_factors = new.item_shards
+            model.sharding = new       # the swap queries dispatch on
+            return
+        if quant is not None:
+            p_ix, p_rows = self._pub_pad(ixs, rows)
+            with devicewatch.attribution("foldin_publish", phase="foldin"):
+                new_q = quant.apply_item_rows(p_ix, p_rows)
+            model.quant = new_q        # the swap queries dispatch on
+            return
+        vf = model.item_factors
+        if isinstance(vf, np.ndarray):
+            return                     # the mirror write above WAS it
+        p_ix, p_rows = self._pub_pad(ixs, rows)
+        with devicewatch.attribution("foldin_publish", phase="foldin"):
+            model.item_factors = scatter_user_rows(vf, p_ix, p_rows)
+
     def _published_row(self, model: Any, ix: int) -> np.ndarray:
         sharding = getattr(model, "sharding", None)
         if sharding is not None:
@@ -1036,6 +1347,28 @@ class FoldinWorker:
         if isinstance(uf, np.ndarray):
             return uf[ix].copy()
         return np.asarray(jax.device_get(uf[ix]))
+
+    def _published_item_row(self, model: Any, ix: int) -> np.ndarray:
+        """The item row a query would actually rank with, dequantized
+        from whichever layout serves (the item drift probe and the
+        bit-parity tests read through this)."""
+        sharding = getattr(model, "sharding", None)
+        if sharding is not None:
+            if sharding.dtype == "int8":
+                q = jax.device_get(sharding.item_shards[ix])
+                s = jax.device_get(sharding.item_scales[ix])
+                return q.astype(np.float32) * np.float32(s)
+            return np.asarray(jax.device_get(sharding.item_shards[ix]))
+        quant = getattr(model, "quant", None)
+        if quant is not None:
+            # serving keeps the item matrix TRANSPOSED on device
+            q = jax.device_get(quant.vt_q[:, ix])
+            s = jax.device_get(quant.v_scale[ix])
+            return q.astype(np.float32) * np.float32(s)
+        vf = model.item_factors
+        if isinstance(vf, np.ndarray):
+            return vf[ix].copy()
+        return np.asarray(jax.device_get(vf[ix]))
 
     # --------------------------------------------------------- drift probe
     def _drift_probe(self, sample: int = 4, k: int = 10) -> None:
@@ -1088,11 +1421,62 @@ class FoldinWorker:
                 floor=drift_recall_floor(), sampled=len(recalls))
         self._note_state()
 
+    def _item_drift_probe(self, sample: int = 4, k: int = 10) -> None:
+        """Transposed twin of :meth:`_drift_probe`: published folded
+        ITEM rows vs a fresh transposed half-step on the same events,
+        compared as rankings over the USER matrix (which users would
+        this item be recommended to) with the same small-catalog
+        clamping. WARN-only, never RED — same posture as the user
+        probe."""
+        model = self._model
+        iids = list(dict.fromkeys(reversed(self._recent_items)))[:sample]
+        if not iids or self._user_factors is None:
+            return
+        U = self._user_factors
+        recalls: List[float] = []
+        for iid in iids:
+            ix = model.item_vocab.get(iid)
+            if ix is None:
+                continue
+            ratings, _unknown = self._gather_item_ratings(
+                iid, model.user_vocab)
+            if not ratings:
+                continue
+            fresh = self._solve([ratings], factors=U)[0]
+            pub = self._published_item_row(model, int(ix))
+            kk = min(k, U.shape[0])
+            if kk >= U.shape[0]:
+                kk = max(U.shape[0] // 2, 1)
+            top_f = np.argsort(-(U @ fresh), kind="stable")[:kk]
+            top_p = np.argsort(-(U @ pub), kind="stable")[:kk]
+            recalls.append(
+                np.intersect1d(top_f, top_p).size / max(kk, 1))
+        if not recalls:
+            return
+        recall = float(np.mean(recalls))
+        ok = recall >= drift_recall_floor()
+        self._item_drift = {"recall": round(recall, 4), "ok": ok,
+                            "sampled": len(recalls),
+                            "checkedAt": _wall_now()}
+        self._m_item_drift.set(recall)
+        if not ok:
+            journal.emit(
+                "foldin",
+                (f"fold-in ITEM drift probe FAILED: recall@{k} "
+                 f"{recall:.4f} < {drift_recall_floor():.2f} floor "
+                 "(published item rows diverge from a fresh transposed "
+                 "half-step; KNOWN_ISSUES #13)"),
+                level=journal.WARN, recall=round(recall, 4),
+                floor=drift_recall_floor(), sampled=len(recalls))
+        self._note_state()
+
     # --------------------------------------------------------------- state
     def _persist(self) -> None:
         try:
             self._store.save(self._cursor, list(self._folded),
-                             list(self._pending))
+                             list(self._pending),
+                             folded_items=list(self._item_folded),
+                             pending_items=list(self._item_pending))
         except OSError:
             logger.warning("foldin: cursor persist failed at %s",
                            self._store.path, exc_info=True)
@@ -1109,6 +1493,9 @@ class FoldinWorker:
             cap = self._capacity
             used = len(self._model.user_vocab) if self._model is not None \
                 else 0
+            icap = self._item_capacity
+            iused = len(self._model.item_vocab) \
+                if self._model is not None else 0
             out: Dict[str, Any] = {
                 "enabled": True,
                 "backend": self._tail.kind if self._tail else None,
@@ -1120,10 +1507,15 @@ class FoldinWorker:
                 "lastTickAt": self._last_tick_at or None,
                 "usersFolded": len(self._folded),
                 "usersPending": len(self._pending),
+                "itemsFolded": len(self._item_folded),
+                "itemsPending": len(self._item_pending),
                 "eventsSeen": self._events_seen,
                 "unknownItems": self._unknown_items,
+                "unknownUsers": self._unknown_users,
                 "capacity": {"rows": cap, "used": used,
                              "headroomLeft": max(cap - used, 0)},
+                "itemCapacity": {"rows": icap, "used": iused,
+                                 "headroomLeft": max(icap - iused, 0)},
             }
             p50 = self._freshness_pct(50)
             p99 = self._freshness_pct(99)
@@ -1133,6 +1525,8 @@ class FoldinWorker:
                                     "observed": len(self._freshness)}
             if self._drift is not None:
                 out["drift"] = dict(self._drift)
+            if self._item_drift is not None:
+                out["itemDrift"] = dict(self._item_drift)
             return out
 
     def _note_state(self) -> None:

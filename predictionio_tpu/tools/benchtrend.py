@@ -167,6 +167,15 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # flapping where it used to converge
     ("autopilot_recovery_s", "down", False),
     ("autopilot_actions_total", "down", False),
+    # continuous-training era (workflow/autotrain.py): seconds from the
+    # trigger decision to the validated candidate live behind the
+    # barrier (the closed-loop freshness promise — the cycle itself is
+    # strict-gated to complete on capable hosts by the bench leg), and
+    # the candidates the validation gate refused — a creeping rise
+    # means retrains are regressing quality and the gate is doing the
+    # serving path's job for it
+    ("autotrain_cycle_s", "down", False),
+    ("autotrain_candidates_rejected", "down", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round

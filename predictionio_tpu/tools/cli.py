@@ -240,6 +240,7 @@ def cmd_deploy(args) -> int:
         foldin=args.foldin,
         foldin_tick_ms=args.foldin_tick_ms,
         foldin_headroom=args.foldin_headroom,
+        foldin_item_headroom=getattr(args, "foldin_item_headroom", 0),
         partition=getattr(args, "partition", "") or "",
     )
     if args.compile_cache:
@@ -255,9 +256,48 @@ def cmd_deploy(args) -> int:
     if undeploy(args.ip, args.port):
         _info(f"Undeployed previous server at {args.ip}:{args.port}.")
     api = QueryAPI(config=config)
+    at = None
+    if getattr(args, "autotrain", False) and not tenants:
+        # embedded autotrain: the continuous-training loop rides the
+        # serving process — retrains run in-process on a thread (the
+        # streamed run_train path), publish is the in-place hot-swap
+        import threading
+
+        from predictionio_tpu.workflow.autotrain import (
+            Autotrain, AutotrainConfig, LocalDeployControl,
+            ThreadTrainer,
+        )
+        from predictionio_tpu.workflow.core_workflow import run_train
+
+        def _retrain() -> str:
+            return run_train(
+                api.ctx, api.engine, api.engine_params,
+                engine_id=config.engine_id,
+                engine_variant=config.engine_variant,
+                engine_factory=variant.get("engineFactory", ""),
+                params_json=variant)
+
+        at = Autotrain(
+            LocalDeployControl(api), storage=api.storage,
+            engine_params=api.engine_params,
+            trainer=ThreadTrainer(_retrain),
+            config=AutotrainConfig(
+                dry_run=getattr(args, "autotrain_dry_run", False)),
+            engine_id=config.engine_id,
+            engine_variant=config.engine_variant)
+        api.attach_autotrain(at)
+        threading.Thread(target=at.run, name="pio-autotrain",
+                         daemon=True).start()
+        _info("Autotrain is "
+              + ("DRY-RUN (journals would-have decisions only)."
+                 if at.config.dry_run else "live."))
     _info(f"Engine is deployed and running. Engine API is live at "
           f"http://{args.ip}:{args.port}.")
-    serve(api, host=args.ip, port=args.port)
+    try:
+        serve(api, host=args.ip, port=args.port)
+    finally:
+        if at is not None:
+            at.close()
     return 0
 
 
@@ -427,6 +467,46 @@ def cmd_router(args) -> int:
         _info("Autopilot is "
               + ("DRY-RUN (journals would-have decisions only)."
                  if ap.config.dry_run else "live."))
+    at = None
+    if getattr(args, "autotrain", False):
+        # embedded autotrain at the fleet front door: retrains run as
+        # `pio train` subprocesses, accepted candidates publish through
+        # this router's own zero-drop /reload barrier
+        import shlex as _shlex
+        import threading
+
+        from predictionio_tpu.data.storage import get_storage
+        from predictionio_tpu.workflow.autotrain import (
+            Autotrain, AutotrainConfig, SubprocessTrainer,
+        )
+        from predictionio_tpu.workflow.autotrain import (
+            LocalRouterControl as AutotrainRouterControl,
+        )
+        from predictionio_tpu.workflow.workflow_utils import (
+            get_engine, read_engine_variant,
+        )
+        engine_dir = os.path.abspath(args.engine_dir)
+        var = read_engine_variant(engine_dir, args.variant)
+        engine = get_engine(var["engineFactory"], base_dir=engine_dir)
+        train_cmd = getattr(args, "train_cmd", "") or (
+            f"{_shlex.quote(sys.executable)} -m "
+            f"predictionio_tpu.tools.cli train --engine-dir "
+            f"{_shlex.quote(engine_dir)} --variant "
+            f"{_shlex.quote(args.variant)}")
+        at = Autotrain(
+            AutotrainRouterControl(api), storage=get_storage(),
+            engine_params=engine.engine_params_from_json(var),
+            trainer=SubprocessTrainer(train_cmd),
+            config=AutotrainConfig(
+                dry_run=getattr(args, "autotrain_dry_run", False)),
+            engine_id=var.get("id", "default"),
+            engine_variant=var.get("id", "default"))
+        api.attach_autotrain(at)
+        threading.Thread(target=at.run, name="pio-autotrain",
+                         daemon=True).start()
+        _info("Autotrain is "
+              + ("DRY-RUN (journals would-have decisions only)."
+                 if at.config.dry_run else "live."))
     _info(f"Router is live at http://{args.ip}:{args.port} over "
           f"{len(api.backends)} backend(s).")
     try:
@@ -434,6 +514,8 @@ def cmd_router(args) -> int:
     finally:
         if ap is not None:
             ap.close()
+        if at is not None:
+            at.close()
     return 0
 
 
@@ -444,6 +526,18 @@ def cmd_autopilot(args) -> int:
     _apply_telemetry_env(args)
     run_autopilot(args.router, dry_run=args.dry_run,
                   replica_cmd=args.replica_cmd)
+    return 0
+
+
+def cmd_autotrain(args) -> int:
+    """Continuous-training control loop (workflow/autotrain.py) over a
+    running deploy server or router: watch drift / cursor lag / event
+    volume / staleness, retrain, validate, publish."""
+    from predictionio_tpu.workflow.autotrain import run_autotrain
+    _apply_telemetry_env(args)
+    run_autotrain(args.server, engine_dir=args.engine_dir,
+                  variant=args.variant, dry_run=args.dry_run,
+                  train_cmd=args.train_cmd)
     return 0
 
 
@@ -851,6 +945,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--foldin-headroom", type=int, default=0,
                     help="user-row capacity pre-padded for fold-in "
                          "appends (0 = PIO_FOLDIN_HEADROOM or 1024)")
+    sp.add_argument("--foldin-item-headroom", type=int, default=0,
+                    help="item-row capacity pre-padded for fold-in of "
+                         "unseen ITEMS (0 = PIO_FOLDIN_ITEM_HEADROOM "
+                         "or 1024)")
+    sp.add_argument("--autotrain", action="store_true",
+                    help="embed the continuous-training control loop "
+                         "in this server process: drift / lag / volume "
+                         "/ staleness triggers, in-process streamed "
+                         "retrain, validation gates, in-place publish "
+                         "(workflow/autotrain.py)")
+    sp.add_argument("--autotrain-dry-run", action="store_true",
+                    help="embedded autotrain journals would-have "
+                         "retrain decisions without training")
     sp.add_argument("--partition", default="",
                     help="partition-routed deploy scope i/N (e.g. 0/4): "
                          "serve only the owned contiguous item-row "
@@ -1025,6 +1132,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "placeholder) the autopilot spawns local "
                          "replica subprocesses from; empty disables "
                          "elastic replica control")
+    sp.add_argument("--autotrain", action="store_true",
+                    help="embed the continuous-training control loop "
+                         "in this router process: retrains run as pio "
+                         "train subprocesses, accepted candidates "
+                         "publish through the zero-drop /reload "
+                         "barrier (workflow/autotrain.py)")
+    sp.add_argument("--autotrain-dry-run", action="store_true",
+                    help="embedded autotrain journals would-have "
+                         "retrain decisions without training")
+    sp.add_argument("--engine-dir", default=".",
+                    help="engine directory the embedded autotrain "
+                         "reads params and launches retrains from")
+    sp.add_argument("--variant", default="engine.json")
+    sp.add_argument("--train-cmd", default="",
+                    help="retrain command the embedded autotrain "
+                         "launches per cycle (default: pio train over "
+                         "--engine-dir/--variant)")
     telemetry_flags(sp)
 
     sp = sub.add_parser(
@@ -1042,6 +1166,29 @@ def build_parser() -> argparse.ArgumentParser:
                          "placeholder) to spawn local replica "
                          "subprocesses; empty disables elastic "
                          "replica control")
+    telemetry_flags(sp)
+
+    sp = sub.add_parser(
+        "autotrain",
+        help="continuous-training control loop over a running deploy "
+             "server or router: drift / cursor-lag / volume / "
+             "staleness triggers, streamed retrain subprocesses with "
+             "crash-resume, score + ranking-parity validation gates, "
+             "barrier publish (workflow/autotrain.py)")
+    sp.add_argument("--server", required=True,
+                    help="deploy-server or router base URL, e.g. "
+                         "http://host:8000")
+    sp.add_argument("--engine-dir", default=".",
+                    help="engine directory to read params and launch "
+                         "retrains from")
+    sp.add_argument("--variant", default="engine.json")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="journal would-have retrain decisions "
+                         "without training")
+    sp.add_argument("--train-cmd", default="",
+                    help="retrain command launched per cycle "
+                         "(default: pio train over "
+                         "--engine-dir/--variant)")
     telemetry_flags(sp)
 
     sp = sub.add_parser("eventserver", help="start the event server")
@@ -1152,6 +1299,7 @@ _DISPATCH = {
     "run": cmd_run,
     "router": cmd_router,
     "autopilot": cmd_autopilot,
+    "autotrain": cmd_autotrain,
     "eventserver": cmd_eventserver,
     "dashboard": cmd_dashboard,
     "adminserver": cmd_adminserver,

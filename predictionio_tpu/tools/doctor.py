@@ -450,6 +450,46 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
             else:
                 checks.append(("autopilot", OK, detail))
 
+    # autotrain (workflow/autotrain.py), embedded deploys/routers ------
+    at = root.get("autotrain")
+    if isinstance(at, dict):
+        mode = at.get("mode", "?")
+        last = at.get("lastDecision")
+        detail = f"mode {mode}, phase {at.get('phase', '?')}"
+        if at.get("retrainInFlight"):
+            detail += ", retrain IN FLIGHT"
+        if at.get("holdoff"):
+            detail += ", HOLDING OFF (skew or reload barrier)"
+        if last:
+            detail += (f", last decision {last.get('trigger', '?')} "
+                       f"({last.get('outcome', '?')}) "
+                       f"{last.get('ageS', '?')}s ago")
+        else:
+            detail += ", no decisions yet"
+        cand = at.get("lastCandidate")
+        if cand:
+            detail += (f", last candidate "
+                       f"{'ACCEPTED' if cand.get('ok') else 'REJECTED'}"
+                       f" ({cand.get('candidateId', '?')})")
+        sig = at.get("signals") or {}
+        thr = at.get("thresholds") or {}
+        if sig.get("cursorLag") is not None:
+            detail += (f", cursor lag {sig['cursorLag']}/"
+                       f"{thr.get('lagEvents', '?')}")
+        if sig.get("volume") is not None:
+            detail += (f", volume {sig['volume']}/"
+                       f"{thr.get('volumeEvents', '?')}")
+        pending = at.get("pendingDryRun") or 0
+        if mode == "dry-run" and pending:
+            checks.append((
+                "autotrain", WARN,
+                detail + f" — {pending} would-have decision(s) "
+                "journaled but NOT applied; the loop believes the "
+                "model needs a retrain (drop --dry-run to let it "
+                "train, or run pio train by hand)"))
+        else:
+            checks.append(("autotrain", OK, detail))
+
     # multi-tenant registry (serving/registry.py) ----------------------
     tenants = root.get("tenants")
     if isinstance(tenants, dict) and tenants:
@@ -664,6 +704,11 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
         if drift.get("recall") is not None:
             detail += (f", drift probe recall {drift['recall']:.4f}"
                        + ("" if drift.get("ok") else " FAILED"))
+        item_drift = foldin_info.get("itemDrift") or {}
+        if item_drift.get("recall") is not None:
+            detail += (f", item drift probe recall "
+                       f"{item_drift['recall']:.4f}"
+                       + ("" if item_drift.get("ok") else " FAILED"))
         import datetime as _dtmod2
         now_ts = _dtmod2.datetime.now(
             _dtmod2.timezone.utc).timestamp()
@@ -679,7 +724,8 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
                            detail + f" — STALE: no tick for "
                            f"{now_ts - float(last_at):.0f} s (worker "
                            "wedged? event store unreachable?)"))
-        elif drift and not drift.get("ok", True):
+        elif ((drift and not drift.get("ok", True))
+                or (item_drift and not item_drift.get("ok", True))):
             checks.append(("foldin", WARN,
                            detail + " — published rows diverge from a "
                            "fresh half-step (KNOWN_ISSUES #13); a "

@@ -142,6 +142,23 @@ def run_train(
     logger.info("train read path: %s (PIO_TRAIN_STREAM=%s)",
                 "streamed (O(chunk) host)" if train_stream else "in-core",
                 _store.train_stream_mode())
+    # training cursor: snapshot the event-store head BEFORE the train
+    # read so the ledger row records the batch base this model absorbed.
+    # Conservative by design — events landing mid-read are re-processed
+    # by the fold-in speed layer (idempotent re-solves), never lost.
+    # autotrain's volume trigger and the fold-in rebase both key off it.
+    train_cursor = None
+    if _events_dao is not None and hasattr(_events_dao, "head_cursor"):
+        try:
+            dsp = getattr(engine_params, "data_source_params", None)
+            _app_name = getattr(dsp, "appName", None)
+            if _app_name:
+                _app = storage.get_meta_data_apps().get_by_name(
+                    str(_app_name))
+                if _app is not None:
+                    train_cursor = _events_dao.head_cursor(_app.id, None)
+        except Exception:   # cursor capture is strictly best-effort
+            train_cursor = None
     import json as _json
     pj = params_json or {}
     instance = EngineInstance(
@@ -227,6 +244,9 @@ def run_train(
                "runtime_conf": {**row.runtime_conf,
                                 "train_stream":
                                     "on" if train_stream else "off",
+                                **({"train_cursor":
+                                    _json.dumps(train_cursor)}
+                                   if train_cursor is not None else {}),
                                 **{f"phase_{k}_s": f"{v:.3f}"
                                    for k, v in phases.items()}}}))
         if phases:

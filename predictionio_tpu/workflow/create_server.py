@@ -137,6 +137,9 @@ class ServerConfig:
     #: user-row capacity headroom pre-padded at load for fold-in
     #: appends (0 = PIO_FOLDIN_HEADROOM or 1024)
     foldin_headroom: int = 0
+    #: item-row capacity headroom pre-padded at load for fold-in of
+    #: unseen ITEMS (0 = PIO_FOLDIN_ITEM_HEADROOM or 1024)
+    foldin_item_headroom: int = 0
     #: partition-routed deploy (parallel/serve_dist.py helpers +
     #: workflow/router.py scatter/merge): "i/N" scopes this replica to
     #: the contiguous item-row range partition_rows(n_items, i, N) —
@@ -180,6 +183,20 @@ def resolve_engine_instance(storage: Storage, config: ServerConfig):
             f"{config.engine_id} {config.engine_version} "
             f"{config.engine_variant}. Try running `pio train` first.")
     return instance
+
+
+def _train_cursor(instance) -> Optional[Any]:
+    """The event-store cursor `run_train` snapshotted at the head of
+    the training read (runtime_conf["train_cursor"], JSON-encoded).
+    None for pre-cursor ledger rows — the fold-in rebase then restarts
+    from the live tail head instead."""
+    raw = (getattr(instance, "runtime_conf", None) or {}).get("train_cursor")
+    if not raw:
+        return None
+    try:
+        return json.loads(raw) if isinstance(raw, str) else raw
+    except ValueError:
+        return None
 
 
 def engine_params_from_instance(engine: Engine, instance) -> EngineParams:
@@ -429,11 +446,17 @@ class QueryAPI:
         if foldin_on:
             headroom = (self.config.foldin_headroom
                         or foldin_mod.default_headroom())
+            item_headroom = (self.config.foldin_item_headroom
+                             or foldin_mod.default_item_headroom())
             if self._foldin_worker is not None:
                 headroom = max(headroom,
                                self._foldin_worker.headroom_hint())
+                item_headroom = max(
+                    item_headroom,
+                    self._foldin_worker.item_headroom_hint())
             foldin_prep = foldin_mod.pad_capacity(
-                models, headroom, algorithms)
+                models, headroom, algorithms,
+                item_headroom=item_headroom)
         # shard-serving + serve-quant scopes (parallel/serve_dist.py,
         # ops/quant.py): each algorithm's prepare_serving resolves the
         # deploy's modes inside them. A reload is flagged so sharding's
@@ -710,7 +733,8 @@ class QueryAPI:
         if worker is None:
             cfg = foldin_mod.config_for(
                 engine_params, tick_ms=self.config.foldin_tick_ms,
-                headroom=self.config.foldin_headroom or None)
+                headroom=self.config.foldin_headroom or None,
+                item_headroom=self.config.foldin_item_headroom or None)
             if cfg is None:
                 journal.emit(
                     "foldin", "fold-in requested but the engine has no "
@@ -734,6 +758,18 @@ class QueryAPI:
                     level=journal.WARN)
                 return
             self._foldin_worker = worker
+        # a reload that landed a NEW training generation (autotrain
+        # publish, or a manual retrain + /reload) invalidates the
+        # speed layer's folded state: those rows were solved against
+        # the OLD batch base. Rebase — drop folded/pending state and
+        # restart the tail from the new instance's training cursor
+        # (head fallback) — BEFORE binding the fresh model.
+        inst = self.engine_instance
+        prev = getattr(self, "_foldin_instance_id", None)
+        if (prev is not None and inst is not None
+                and inst.id != prev):
+            worker.rebase(cursor=_train_cursor(inst))
+        self._foldin_instance_id = inst.id if inst is not None else None
         worker.bind(models[prep["index"]], generation=self.generation,
                     prep=prep, reload_cb=self._reload)
         worker.start()
@@ -1022,7 +1058,18 @@ class QueryAPI:
             # only with the fold-in worker live: PIO_FOLDIN=0 deploys
             # keep the exact legacy key set (wire parity, asserted)
             out["foldin"] = worker.state()
+        at = getattr(self, "_autotrain", None)
+        if at is not None:
+            # only with --autotrain embedded: plain deploys keep the
+            # exact legacy key set (wire parity)
+            out["autotrain"] = at.summary()
         return out
+
+    def attach_autotrain(self, autotrain) -> None:
+        """Embedded `pio deploy --autotrain`: surface the scheduler's
+        summary() under GET / so `pio doctor` and operators see the
+        trigger/decision state next to the serving stats."""
+        self._autotrain = autotrain
 
     def _status_mt(self) -> Dict[str, Any]:
         """The multi-tenant `GET /` shape: per-tenant state blocks and

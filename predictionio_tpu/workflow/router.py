@@ -495,6 +495,10 @@ class RouterAPI:
         #: attach_autopilot; the status payload grows an "autopilot"
         #: block only while one is attached (wire parity)
         self._autopilot: Optional[Any] = None
+        #: embedded autotrain (pio router --autotrain): set via
+        #: attach_autotrain; the status payload grows an "autotrain"
+        #: block the doctor reads
+        self._autotrain: Optional[Any] = None
         #: front-door response cache (None unless --cache/PIO_ROUTER_CACHE
         #: turns it on: the off path stays byte-identical to PR 16)
         self._cache: Optional[_ResponseCache] = None
@@ -744,6 +748,9 @@ class RouterAPI:
 
     def attach_autopilot(self, ap: Any) -> None:
         self._autopilot = ap
+
+    def attach_autotrain(self, autotrain: Any) -> None:
+        self._autotrain = autotrain
 
     # ------------------------------------------------------ partition map
     def _rebuild_pmap(self) -> None:
@@ -1032,6 +1039,10 @@ class RouterAPI:
             # embedded-autopilot routers only (same parity rule): the
             # block `pio doctor`'s autopilot line reads
             out["autopilot"] = self._autopilot.summary()
+        if self._autotrain is not None:
+            # embedded-autotrain routers only (same parity rule): the
+            # block `pio doctor`'s autotrain line reads
+            out["autotrain"] = self._autotrain.summary()
         return out
 
     # ------------------------------------------------------- admin routes

@@ -1,0 +1,641 @@
+"""`pio autotrain` — continuous training (workflow/autotrain.py).
+
+The contracts under test:
+
+- e2e embedded: a live event burst crosses the volume trigger, a
+  streamed retrain runs in-process, the candidate clears both
+  validation gates and publishes through the in-place swap — zero
+  dropped queries, a monotonic generation bump, the decision journaled
+  with its triggering evidence, and the fold-in worker rebased onto
+  the new batch base;
+- the reject path: a seeded-WORSE candidate is refused by the
+  validation gates, its ledger row flips to REJECTED (so no resolve
+  ever deploys it), the evidence is journaled, and the prior
+  generation keeps serving;
+- `--dry-run` provably trains nothing: the trainer is never started
+  and storage is untouched while would-have decisions are journaled,
+  counted, and surfaced by the doctor as a WARN;
+- trigger mechanics: evaluation priority (drift before lag before
+  volume before staleness), per-class cooldowns charged at decision
+  time, the one-retrain-in-flight guard, and hold-off under
+  generation skew / a running reload barrier (journaled once per
+  transition);
+- crash-resume: a dead retrain is restarted exactly once (iteration-
+  snapshot auto-resume), a second death fails the cycle;
+- the standalone plumbing: CLI parse surfaces, the doctor autotrain
+  line, and validate_candidate's skip-vs-measure honesty.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.common import journal, telemetry
+from predictionio_tpu.data.storage import (
+    EngineInstance, Model, Storage,
+)
+from predictionio_tpu.tools import doctor
+from predictionio_tpu.workflow import model_io
+from predictionio_tpu.workflow.autotrain import (
+    Autotrain, AutotrainConfig, LocalDeployControl, ServerControl,
+    Signals, Trainer, mark_rejected, validate_candidate,
+)
+from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+from tests.test_foldin import APP, _mk_event, _train
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    journal.clear()
+    telemetry.set_enabled(None)
+    yield
+    telemetry.set_enabled(None)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    engine = _train(storage)
+    return storage, engine
+
+
+def _cfg(**kw):
+    kw.setdefault("poll_ms", 50.0)
+    kw.setdefault("cooldown_s", 30.0)
+    kw.setdefault("max_staleness_s", 3600.0)
+    kw.setdefault("volume_events", 10)
+    kw.setdefault("lag_events", 10)
+    kw.setdefault("tolerance", 0.02)
+    kw.setdefault("parity_min", 0.2)
+    kw.setdefault("probe", 64)
+    kw.setdefault("publish_timeout_s", 10.0)
+    return AutotrainConfig(**kw)
+
+
+class FakeControl(ServerControl):
+    """In-memory serving stand-in: a mutable status dict plus a
+    publish that bumps the generation (the real swap's observable)."""
+
+    def __init__(self, **status):
+        self._status = {"generation": 1, "generationSkew": False,
+                        "reload": {"active": False}, **status}
+        self.publishes = 0
+
+    def status(self):
+        return dict(self._status)
+
+    def publish(self):
+        self.publishes += 1
+        self._status["generation"] += 1
+
+
+class FakeTrainer(Trainer):
+    def __init__(self):
+        self.started = 0
+        self.results = []        # popped per attempt, FIFO
+        self._live = None
+
+    def start(self):
+        if self.running:
+            raise RuntimeError("a retrain is already in flight")
+        self.started += 1
+        self._live = self.results.pop(0) if self.results else None
+
+    @property
+    def running(self):
+        return False
+
+    def poll(self):
+        return self._live
+
+
+def _fake_storage_autotrain(control=None, trainer=None, **cfg_kw):
+    """State-machine-only loop: no storage reads happen until a cycle
+    reaches validation, so a None storage keeps the test honest about
+    what each phase touches."""
+    return Autotrain(control or FakeControl(), storage=None,
+                     trainer=trainer or FakeTrainer(),
+                     config=_cfg(**cfg_kw))
+
+
+def _sig(**kw):
+    kw.setdefault("now", 1000.0)
+    return Signals(**kw)
+
+
+# ---------------------------------------------------------------------------
+# trigger mechanics (state machine driven directly, fake clock)
+# ---------------------------------------------------------------------------
+
+def test_staleness_trigger_fires_and_cooldown_holds():
+    trainer = FakeTrainer()
+    at = _fake_storage_autotrain(trainer=trainer)
+    acted = at.tick(_sig(staleness_s=4000.0))
+    assert [a["trigger"] for a in acted] == ["staleness"]
+    assert acted[0]["outcome"] == "ok"
+    assert trainer.started == 1 and at._phase == "retraining"
+    # cooldown charged at decision time: an idle loop seeing the same
+    # signal within the window decides nothing
+    at._phase = "idle"
+    assert at.tick(_sig(now=1010.0, staleness_s=4000.0)) == []
+    # past the cooldown it fires again
+    assert [a["trigger"] for a in
+            at.tick(_sig(now=1031.0, staleness_s=4000.0))] \
+        == ["staleness"]
+
+
+def test_trigger_priority_drift_wins_and_evidence_journaled():
+    at = _fake_storage_autotrain()
+    acted = at.tick(_sig(drift=0.5, item_drift=0.4, cursor_lag=999,
+                         volume=999, staleness_s=99999.0))
+    assert [a["trigger"] for a in acted] == ["drift"]
+    evs = [e for e in journal.snapshot(category="autotrain")["events"]
+           if e["fields"].get("trigger") == "drift"]
+    assert len(evs) == 1
+    # the decision carries its triggering evidence: the worst recall,
+    # the floor, and which sides drifted
+    assert evs[0]["fields"]["driftRecall"] == 0.4
+    assert evs[0]["fields"]["sides"] == ["user", "item"]
+    assert "drift recall 0.400" in evs[0]["message"]
+
+
+def test_item_drift_alone_triggers():
+    at = _fake_storage_autotrain()
+    acted = at.tick(_sig(item_drift=0.3))
+    assert [a["trigger"] for a in acted] == ["drift"]
+    ev = journal.snapshot(category="autotrain")["events"][-1]
+    assert ev["fields"]["sides"] == ["item"]
+
+
+def test_lag_and_volume_triggers():
+    at = _fake_storage_autotrain()
+    acted = at.tick(_sig(cursor_lag=25, volume=25))
+    assert [a["trigger"] for a in acted] == ["lag"]
+    at2 = _fake_storage_autotrain()
+    acted = at2.tick(_sig(volume=25))
+    assert [a["trigger"] for a in acted] == ["volume"]
+    assert at2.tick(_sig(now=1001.0, volume=5)) == []   # under threshold
+
+
+def test_one_retrain_in_flight_guard():
+    trainer = FakeTrainer()
+    at = _fake_storage_autotrain(trainer=trainer)
+    at.tick(_sig(staleness_s=4000.0))
+    assert at._phase == "retraining"
+    # every trigger saturated, but a cycle is in flight: nothing fires
+    acted = at.tick(_sig(now=2000.0, drift=0.1, cursor_lag=999,
+                         volume=999, staleness_s=99999.0))
+    assert acted == [] and trainer.started == 1
+
+
+def test_holdoff_blocks_triggers_and_journals_transitions():
+    at = _fake_storage_autotrain()
+    assert at.tick(_sig(generation_skew=True, staleness_s=9999.0)) == []
+    assert at.tick(_sig(now=1001.0, generation_skew=True,
+                        staleness_s=9999.0)) == []
+    msgs = [e["message"] for e in
+            journal.snapshot(category="autotrain")["events"]]
+    assert sum("holding off" in m for m in msgs) == 1   # once per edge
+    at.tick(_sig(now=1002.0))
+    msgs = [e["message"] for e in
+            journal.snapshot(category="autotrain")["events"]]
+    assert sum("hold-off cleared" in m for m in msgs) == 1
+
+
+def test_crash_resume_once_then_fail_cycle():
+    trainer = FakeTrainer()
+    trainer.results = [{"ok": False, "error": "boom 1"},
+                       {"ok": False, "error": "boom 2"}]
+    at = _fake_storage_autotrain(trainer=trainer)
+    at.tick(_sig(staleness_s=9999.0))
+    assert trainer.started == 1 and at._phase == "retraining"
+    at.tick(_sig(now=1001.0))           # crash -> one restart
+    assert trainer.started == 2 and at._phase == "retraining"
+    msgs = [e["message"] for e in
+            journal.snapshot(category="autotrain")["events"]]
+    assert any("restarting once" in m for m in msgs)
+    at.tick(_sig(now=1002.0))           # second crash -> cycle fails
+    assert at._phase == "idle"
+    reds = [e for e in journal.snapshot(level="red")["events"]
+            if e["category"] == "autotrain"]
+    assert any("failed twice" in e["message"] for e in reds)
+
+
+# ---------------------------------------------------------------------------
+# dry-run provably trains nothing
+# ---------------------------------------------------------------------------
+
+def test_dry_run_decides_without_training():
+    trainer = FakeTrainer()
+    at = _fake_storage_autotrain(trainer=trainer, dry_run=True)
+    acted = at.tick(_sig(volume=999))
+    assert [a["outcome"] for a in acted] == ["dry_run"]
+    assert trainer.started == 0 and at._phase == "idle"
+    ev = journal.snapshot(category="autotrain")["events"][-1]
+    assert ev["message"].startswith("DRY-RUN would: ")
+    assert ev["fields"]["volume"] == 999
+    s = at.summary()
+    assert s["mode"] == "dry-run" and s["pendingDryRun"] == 1
+    # dry-run paces exactly like the live loop: cooldown was charged
+    assert at.tick(_sig(now=1001.0, volume=999)) == []
+
+
+# ---------------------------------------------------------------------------
+# candidate validation (real models)
+# ---------------------------------------------------------------------------
+
+def _live_instance(storage):
+    return storage.get_meta_data_engine_instances().get_latest_completed(
+        "default", "NOT_USED", "default")
+
+
+def _seed_candidate(storage, live_id, corrupt=False):
+    """Clone the live generation's ledger row + blob as a fresh
+    COMPLETED candidate; with ``corrupt``, flip the user factors so
+    every ranking inverts (a provably worse model)."""
+    instances = storage.get_meta_data_engine_instances()
+    row = instances.get(live_id)
+    models = model_io.deserialize_models(
+        storage.get_model_data_models().get(live_id).models)
+    if corrupt:
+        m = models[0]
+        m.user_factors = -np.asarray(m.user_factors, np.float32)
+    cand_id = instances.insert(EngineInstance(
+        **{**row.__dict__, "id": "", "status": "COMPLETED"}))
+    storage.get_model_data_models().insert(Model(
+        id=cand_id, models=model_io.serialize_models(models)))
+    return cand_id
+
+
+def test_validate_clone_passes_both_gates(trained):
+    storage, engine = trained
+    live = _live_instance(storage).id
+    cand = _seed_candidate(storage, live)
+    ep = QueryAPI(storage=storage, engine=engine,
+                  config=ServerConfig()).engine_params
+    v = validate_candidate(storage, ep, live, cand)
+    assert v["ok"], v
+    assert v["score"]["ok"] and v["score"]["probeTriples"] > 0
+    assert v["parity"]["ok"] and v["parity"]["recall"] == 1.0
+
+
+def test_validate_rejects_seeded_worse_candidate(trained):
+    storage, engine = trained
+    live = _live_instance(storage).id
+    cand = _seed_candidate(storage, live, corrupt=True)
+    ep = QueryAPI(storage=storage, engine=engine,
+                  config=ServerConfig()).engine_params
+    v = validate_candidate(storage, ep, live, cand)
+    assert not v["ok"]
+    assert v["reasons"]          # evidence, not a bare verdict
+    mark_rejected(storage, cand)
+    row = storage.get_meta_data_engine_instances().get(cand)
+    assert row.status == "REJECTED"
+    # no resolve ever deploys it: latest-completed skips REJECTED rows
+    assert _live_instance(storage).id != cand
+
+
+def test_validate_skips_are_explicit():
+    """A gate that cannot run must say so — never silently pass as
+    measured. No live id => both gates skip; no candidate blob =>
+    reject outright."""
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    v = validate_candidate(storage, None, None, "ghost")
+    assert not v["ok"] and "no model blob" in v["reasons"][0]
+    storage.get_model_data_models().insert(
+        Model(id="c1", models=model_io.serialize_models([object()])))
+    v = validate_candidate(storage, None, None, "c1")
+    assert v["ok"]
+    assert "skipped" in v["score"] and "skipped" in v["parity"]
+
+
+# ---------------------------------------------------------------------------
+# reject path through the state machine (prior generation keeps serving)
+# ---------------------------------------------------------------------------
+
+def test_reject_cycle_keeps_prior_generation_serving(trained):
+    storage, engine = trained
+    live = _live_instance(storage).id
+    cand = _seed_candidate(storage, live, corrupt=True)
+    ep = QueryAPI(storage=storage, engine=engine,
+                  config=ServerConfig()).engine_params
+    control = FakeControl()
+    trainer = FakeTrainer()
+    trainer.results = [{"ok": True, "instanceId": cand}]
+    at = Autotrain(control, storage=storage, engine_params=ep,
+                   trainer=trainer, config=_cfg())
+    at._live_id = live
+    at.tick(_sig(staleness_s=9999.0, live_instance_id=live))
+    assert at._phase == "retraining"
+    at.tick(_sig(now=1001.0))    # poll -> candidate -> validate: REJECT
+    assert at._phase == "idle"
+    assert control.publishes == 0                       # never published
+    assert control.status()["generation"] == 1          # prior serves
+    assert storage.get_meta_data_engine_instances().get(cand).status \
+        == "REJECTED"
+    reds = [e for e in journal.snapshot(level="red")["events"]
+            if e["category"] == "autotrain"]
+    assert any("REJECTED" in e["message"]
+               and "prior generation keeps serving" in e["message"]
+               for e in reds)
+    s = at.summary()
+    assert s["candidatesRejected"] == 1
+    assert s["lastCandidate"]["candidateId"] == cand
+    assert not s["lastCandidate"]["ok"]
+
+
+def test_accept_cycle_publishes_and_bumps_generation(trained):
+    storage, engine = trained
+    live = _live_instance(storage).id
+    cand = _seed_candidate(storage, live)
+    ep = QueryAPI(storage=storage, engine=engine,
+                  config=ServerConfig()).engine_params
+    control = FakeControl()
+    trainer = FakeTrainer()
+    trainer.results = [{"ok": True, "instanceId": cand}]
+    at = Autotrain(control, storage=storage, engine_params=ep,
+                   trainer=trainer, config=_cfg())
+    at._live_id = live
+    at.tick(_sig(volume=999, live_instance_id=live))
+    at.tick(_sig(now=1001.0))    # poll -> validate: ACCEPT -> publish
+    assert at._phase == "idle" and control.publishes == 1
+    assert control.status()["generation"] == 2
+    s = at.summary()
+    assert s["lastCycle"]["candidateId"] == cand
+    assert s["lastCycle"]["generation"] == 2
+    assert at._live_id == cand
+    msgs = [e["message"] for e in
+            journal.snapshot(category="autotrain")["events"]]
+    assert any("published: generation 2 live" in m for m in msgs)
+
+
+def test_publish_waits_out_holdoff(trained):
+    storage, engine = trained
+    live = _live_instance(storage).id
+    cand = _seed_candidate(storage, live)
+    ep = QueryAPI(storage=storage, engine=engine,
+                  config=ServerConfig()).engine_params
+    control = FakeControl()
+    trainer = FakeTrainer()
+    trainer.results = [{"ok": True, "instanceId": cand}]
+    at = Autotrain(control, storage=storage, engine_params=ep,
+                   trainer=trainer, config=_cfg())
+    at._live_id = live
+    at.tick(_sig(staleness_s=9999.0))
+    at.tick(_sig(now=1001.0, reload_active=True))   # validated, but a
+    assert at._phase == "publishing"                # barrier is running
+    assert control.publishes == 0
+    at.tick(_sig(now=1002.0))                       # barrier done
+    assert at._phase == "idle" and control.publishes == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e embedded: burst -> volume trigger -> real retrain -> validated ->
+# published in-place -> fold-in rebased; zero drops, generation bump
+# ---------------------------------------------------------------------------
+
+def test_e2e_burst_trigger_retrain_publish_zero_drops(trained,
+                                                      monkeypatch):
+    monkeypatch.setenv("PIO_FOLDIN_CURSOR_DIR", "/tmp/at_e2e_cur")
+    monkeypatch.setenv("PIO_FOLDIN_USER_BUCKETS", "1,4")
+    monkeypatch.setenv("PIO_FOLDIN_MAX_EVENTS", "16")
+    storage, engine = trained
+    from predictionio_tpu.workflow.autotrain import ThreadTrainer
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    api = QueryAPI(storage=storage, engine=engine,
+                   config=ServerConfig(batching="on", foldin="on",
+                                       foldin_tick_ms=20.0,
+                                       foldin_headroom=16))
+    try:
+        gen_before = api.generation
+        live_before = api.engine_instance.id
+
+        def _retrain() -> str:
+            return run_train(
+                api.ctx, api.engine, api.engine_params,
+                engine_factory="foldin-test",
+                params_json={
+                    "datasource": {"params": {"appName": APP}},
+                    "algorithms": [{"name": "als", "params": {
+                        "rank": 4, "numIterations": 4,
+                        "lambda": 0.05, "seed": 3}}]})
+
+        at = Autotrain(LocalDeployControl(api), storage=storage,
+                       engine_params=api.engine_params,
+                       trainer=ThreadTrainer(_retrain),
+                       config=_cfg(volume_events=5))
+        api.attach_autotrain(at)
+
+        burst_errors = []
+        stop = threading.Event()
+
+        def burst(cx):
+            try:
+                while not stop.is_set():
+                    status, body = api.handle(
+                        "POST", "/queries.json",
+                        body=json.dumps({"user": f"u{cx}",
+                                         "num": 10}).encode())
+                    if status != 200 or not body.get("itemScores"):
+                        burst_errors.append((status, body))
+                        return
+            except Exception as e:      # a dropped query IS a failure
+                burst_errors.append(e)
+
+        clients = [threading.Thread(target=burst, args=(cx,))
+                   for cx in range(3)]
+        for t in clients:
+            t.start()
+        try:
+            # the live burst that crosses the volume trigger
+            app_id = storage.get_meta_data_apps().get_by_name(APP).id
+            storage.get_events().insert_batch(
+                [_mk_event(f"u{u}", f"i{i}", 3.0, month=11)
+                 for u in range(4) for i in range(3)], app_id)
+            deadline = time.monotonic() + 120.0
+            decided = False
+            while time.monotonic() < deadline:
+                at.tick(at.gather())
+                decided = decided or at._phase != "idle"
+                if decided and at._phase == "idle":
+                    break
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in clients:
+                t.join(timeout=10)
+            at.close()
+
+        assert not burst_errors, burst_errors[:3]   # zero drops
+        assert api.generation == gen_before + 1     # monotonic bump
+        assert api.engine_instance.id != live_before
+        assert api.engine_instance.id == at._live_id
+        # the decision journaled with its volume evidence, the cycle
+        # journaled with its generation
+        evs = journal.snapshot(category="autotrain")["events"]
+        dec = [e for e in evs
+               if e["fields"].get("trigger") == "volume"
+               and e["fields"].get("outcome") == "ok"]
+        assert dec and dec[0]["fields"]["volume"] >= 5
+        assert any("published: generation" in e["message"]
+                   for e in evs)
+        # fold-in rebased onto the new batch base: cursor/drift reset
+        fold = [e for e in journal.snapshot(category="foldin")["events"]
+                if "rebased" in e["message"]]
+        assert fold, "fold-in was not rebased after the publish"
+        assert api._foldin_instance_id == api.engine_instance.id
+        s = at.summary()
+        assert s["lastCycle"]["cycleS"] > 0
+        assert s["lastCandidate"]["ok"]
+    finally:
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor + CLI surfaces
+# ---------------------------------------------------------------------------
+
+def _scraped(root):
+    ok = {"status": 200, "body": json.dumps({"status": "ok"})}
+    return {
+        "url": "http://t", "healthz": dict(ok), "readyz": dict(ok),
+        "root": {"status": 200, "body": json.dumps(root)},
+        "metrics": {"status": 200, "body": ""},
+        "traces": {"status": 404, "body": ""},
+        "device": {"status": 200, "body": json.dumps(
+            {"telemetry": True})},
+    }
+
+
+def test_doctor_autotrain_line_ok_and_dry_run_warn():
+    root = {"autotrain": {
+        "mode": "live", "phase": "idle", "holdoff": False,
+        "retrainInFlight": False, "cooldownS": 600.0, "cooling": [],
+        "decisionsTotal": 2, "pendingDryRun": 0,
+        "candidatesRejected": 1,
+        "lastDecision": {"trigger": "volume", "outcome": "ok",
+                         "message": "start streamed retrain",
+                         "ageS": 33.0, "at": "t"},
+        "lastCandidate": {"candidateId": "abc", "ok": True},
+        "lastCycle": {"candidateId": "abc", "generation": 3,
+                      "cycleS": 41.0},
+        "thresholds": {"maxStalenessS": 86400.0, "volumeEvents": 5000,
+                       "lagEvents": 5000, "driftFloor": 0.99},
+        "signals": {"stalenessS": 120.0, "volume": 123,
+                    "cursorLag": 7, "drift": 1.0, "itemDrift": None},
+    }}
+    checks = doctor.diagnose(_scraped(root))
+    check = next(c for c in checks if c[0] == "autotrain")
+    assert check[1] == doctor.OK
+    assert "last decision volume (ok) 33.0s ago" in check[2]
+    assert "cursor lag 7/5000" in check[2]
+    assert "volume 123/5000" in check[2]
+    assert "last candidate ACCEPTED" in check[2]
+    # dry-run with pending would-haves: the loop believes the model
+    # needs a retrain nobody is running
+    root["autotrain"].update(mode="dry-run", pendingDryRun=3)
+    checks = doctor.diagnose(_scraped(root))
+    check = next(c for c in checks if c[0] == "autotrain")
+    assert check[1] == doctor.WARN
+    assert "3 would-have decision(s)" in check[2]
+
+
+def test_doctor_foldin_line_surfaces_item_drift():
+    root = {"foldin": {"enabled": True, "cursorLag": 0,
+                       "lastTickMs": 1.0,
+                       "drift": {"recall": 1.0, "ok": True},
+                       "itemDrift": {"recall": 0.5, "ok": False}}}
+    scraped = _scraped({})
+    scraped["device"] = {"status": 200, "body": json.dumps(
+        {"telemetry": True, "foldin": root["foldin"]})}
+    checks = doctor.diagnose(scraped)
+    check = next(c for c in checks if c[0] == "foldin")
+    assert check[1] == doctor.WARN              # WARN, never RED
+    assert "item drift probe recall 0.5000 FAILED" in check[2]
+
+
+def test_cli_parses_autotrain_surfaces():
+    from predictionio_tpu.tools.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["autotrain", "--server", "http://h:8000",
+                         "--dry-run", "--train-cmd", "true"])
+    assert args.server == "http://h:8000" and args.dry_run
+    args = p.parse_args(["deploy", "--autotrain",
+                         "--autotrain-dry-run",
+                         "--foldin-item-headroom", "32"])
+    assert args.autotrain and args.autotrain_dry_run
+    assert args.foldin_item_headroom == 32
+    args = p.parse_args(["router", "--backends", "http://h:1",
+                         "--autotrain", "--engine-dir", "/e"])
+    assert args.autotrain and args.engine_dir == "/e"
+
+
+def test_declarations_cover_autotrain():
+    """One seeded defect -> exactly one finding: the autotrain families
+    are inside the declarations triangle, and an undeclared sibling
+    metric still fails the pass."""
+    from predictionio_tpu.common import declarations
+    from predictionio_tpu.tools.analyze.passes import (
+        declarations as decl_pass,
+    )
+    from tests.test_lint import _mod
+
+    for name in ("PIO_AUTOTRAIN_POLL_MS", "PIO_AUTOTRAIN_TOLERANCE",
+                 "PIO_AUTOTRAIN_PUBLISH_TIMEOUT_S",
+                 "PIO_FOLDIN_ITEM_HEADROOM"):
+        assert name in declarations.ENV_VARS
+    for name in ("pio_autotrain_decisions_total",
+                 "pio_autotrain_candidates_total",
+                 "pio_autotrain_state",
+                 "pio_autotrain_last_decision_age_seconds",
+                 "pio_foldin_item_drift_recall",
+                 "pio_foldin_items_total"):
+        assert name in declarations.METRICS
+    assert "autotrain" in declarations.JOURNAL_CATEGORIES
+    src = ("from predictionio_tpu.common import telemetry\n"
+           "c = telemetry.registry().counter(\n"
+           "    'pio_autotrain_ghost_total', 'x')\n")
+    found = [f for f in decl_pass.run([_mod(src)], readme_text="")
+             if f.rule == "metric-undeclared"]
+    assert len(found) == 1
+    assert "pio_autotrain_ghost_total" in found[0].message
+
+
+def test_run_loop_stops_and_survives_gather_failure():
+    class DeadControl(ServerControl):
+        def status(self):
+            raise RuntimeError("server restarting")
+
+        def publish(self):
+            pass
+
+    at = Autotrain(DeadControl(), storage=None, trainer=FakeTrainer(),
+                   config=_cfg(poll_ms=10.0))
+    t = threading.Thread(target=at.run, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    at.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    warns = [e for e in journal.snapshot(level="warn")["events"]
+             if e["category"] == "autotrain"]
+    # one WARN per failure streak, not one per tick
+    assert len([e for e in warns
+                if "signal gather failed" in e["message"]]) == 1

@@ -865,3 +865,164 @@ def test_worker_bind_emits_journal_and_state(trained):
         assert isinstance(dev, dict)
     finally:
         api.close()
+
+
+# ---------------------------------------------------------------------------
+# item fold-in: unseen ITEMS become rankable without a retrain (the
+# transposed half-step into every serving layout)
+# ---------------------------------------------------------------------------
+
+def _rate_new_item(storage, iid, parity=0, month=7):
+    """Known users of one parity class rate a brand-new item highly —
+    its solved factors land in that parity's item cluster."""
+    evs = [_mk_event(f"u{u}", iid, 5.0, minute=u, month=month)
+           for u in range(parity, 8, 2)]
+    storage.get_events().insert_batch(evs, _app_id(storage))
+
+
+@pytest.mark.parametrize("extra", [
+    {},
+    {"shard_serving": "on", "serve_quant": "on"},
+], ids=["replicated", "sharded+quant"])
+def test_unseen_item_servable_within_2s(trained, extra):
+    """An item the trainer never saw is rated by live events and must
+    rank in an even user's top-k within 2 s — no retrain, no /reload,
+    vocab grown in place — on the replicated AND sharded+quantized
+    layouts."""
+    storage, engine = trained
+    iid = f"inew_{'sq' if extra else 'rep'}"
+    api = _api(storage, engine, **extra)
+    try:
+        worker = api._foldin_worker
+        assert worker is not None and worker.supported
+        generation_before = api.generation
+        t0 = time.perf_counter()
+        _rate_new_item(storage, iid, parity=0)
+        items = []
+        while time.perf_counter() - t0 < 2.0:
+            status, body = _post(api, "u0", num=10)
+            assert status == 200
+            items = [s["item"] for s in body["itemScores"]]
+            if iid in items:
+                break
+            time.sleep(0.01)
+        assert iid in items, items
+        # rankable AND ranked like the even cluster it was rated into
+        assert iid in items[:4], items
+        assert api.generation == generation_before   # no /reload
+        st = api.handle("GET", "/")[1]["foldin"]
+        assert st["itemsFolded"] >= 1
+        assert st["itemCapacity"]["rows"] > st["itemCapacity"]["used"]
+    finally:
+        api.close()
+
+
+@pytest.mark.parametrize("extra", [
+    {},
+    {"shard_serving": "on"},
+    {"serve_quant": "on"},
+    {"shard_serving": "on", "serve_quant": "on"},
+], ids=["fp32", "sharded", "int8", "sharded+int8"])
+def test_item_foldin_bit_parity_per_layout(trained, extra):
+    """The folded item row every layout actually serves equals a fresh
+    transposed half-step on the same events — bit-level: fp32 layouts
+    carry the solve output verbatim, int8 layouts carry exactly its
+    per-row symmetric quantization."""
+    import jax
+
+    from predictionio_tpu.ops import quant as quant_mod
+
+    storage, engine = trained
+    iid = "ipar_" + "_".join(sorted(extra)) if extra else "ipar_rep"
+    api = _api(storage, engine, **extra)
+    try:
+        worker = api._foldin_worker
+        worker.stop()   # drive the tick deterministically
+        _rate_new_item(storage, iid, parity=1, month=8)
+        summary = worker.tick()
+        assert summary["itemsAppended"] >= 1, summary
+        model = api.models[0]
+        ix = model.item_vocab.get(iid)
+        assert ix is not None and ix >= 6   # appended past the 6
+                                            # trained items
+        # the tick re-solved the rating users AFTER the item folded
+        # (items fold first); re-fold the item so both sides of the
+        # comparison see the same, now-stable user matrix
+        folded, _appended, _deferred = worker._fold_items([iid], {})
+        assert folded == 1
+        ratings, unknown = worker._gather_item_ratings(
+            iid, model.user_vocab)
+        assert ratings and unknown == 0
+        fresh = np.asarray(jax.device_get(
+            worker._solve([ratings], factors=worker._user_factors)[0]),
+            np.float32)
+        # the worker's host mirror (the user solves' gather source)
+        # carries the solve output verbatim on every layout
+        np.testing.assert_array_equal(worker._item_factors[int(ix)],
+                                      fresh)
+        pub = worker._published_item_row(model, int(ix))
+        sharding = getattr(model, "sharding", None)
+        int8 = (getattr(model, "quant", None) is not None
+                or (sharding is not None and sharding.dtype == "int8"))
+        expect = fresh
+        if int8:
+            q, s = quant_mod.quantize_rows(fresh[None])
+            expect = quant_mod.dequantize_rows(q, s)[0]
+        np.testing.assert_array_equal(pub, expect)
+    finally:
+        api.close()
+
+
+def test_trained_items_never_resolved_by_foldin(trained):
+    """New events against an item the TRAINER knew must not overwrite
+    its batch-solved row with a single half-step (the item-side
+    correctness rule; users re-solve, trained items do not)."""
+    storage, engine = trained
+    api = _api(storage, engine)
+    try:
+        worker = api._foldin_worker
+        worker.stop()
+        model = api.models[0]
+        ix = model.item_vocab.get("i0")
+        before = np.array(worker._item_factors[int(ix)])
+        storage.get_events().insert_batch(
+            [_mk_event(f"u{u}", "i0", 1.0, month=9) for u in range(4)],
+            _app_id(storage))
+        summary = worker.tick()
+        assert summary.get("itemsFolded", 0) == 0
+        np.testing.assert_array_equal(worker._item_factors[int(ix)],
+                                      before)
+    finally:
+        api.close()
+
+
+def test_item_drift_probe_clean_and_corrupted(trained, monkeypatch):
+    from predictionio_tpu.common import journal
+
+    # host-numpy layout so the corruption below can write the
+    # published row in place (same trick as the user-side probe test)
+    monkeypatch.setenv("PIO_SERVE_DEVICE_MS", "0")
+    storage, engine = trained
+    api = _api(storage, engine)
+    try:
+        worker = api._foldin_worker
+        worker.stop()
+        _rate_new_item(storage, "idrift", parity=0, month=10)
+        worker.tick()
+        worker._item_drift_probe()
+        st = worker.state()
+        assert st["itemDrift"]["ok"] and st["itemDrift"]["recall"] == 1.0
+        journal.clear()
+        model = api.models[0]
+        ix = model.item_vocab.get("idrift")
+        model.item_factors[int(ix)] = -model.item_factors[int(ix)]
+        worker._item_factors[int(ix)] = \
+            np.array(model.item_factors[int(ix)])
+        worker._item_drift_probe()
+        st = worker.state()
+        assert not st["itemDrift"]["ok"]
+        warns = [e for e in journal.snapshot(level="warn")["events"]
+                 if e["category"] == "foldin"]
+        assert any("ITEM drift" in e["message"] for e in warns)
+    finally:
+        api.close()
