@@ -1125,6 +1125,143 @@ def measure_journal(storage, engine, n_conns: int = 8,
     }
 
 
+def measure_history(storage, engine, n_conns: int = 8,
+                    queries_per_client: int = 100):
+    """Metrics-flight-recorder leg (common/history.py): the same
+    batched serving path with PIO_HISTORY off vs on (telemetry ON in
+    both legs, sampler ticking at a bench-fast cadence in the on leg),
+    plus a /debug/history.json read taken WHILE the burst is running.
+
+    The recorder's cost model is "the hot path pays nothing" — sampling
+    runs on its own thread at scrape cadence — so history-on p99 must
+    sit within 5% of history-off (absolute floor 0.2 ms, like the
+    telemetry/journal legs). The on leg must also actually RECORD: the
+    mid-burst read must answer 200 with >= 1 sample carrying
+    pio_serve_seconds bucket deltas, and the ring must stay bounded
+    (seriesTotal <= the PIO_HISTORY_MAX_SERIES cap). Hard-fails under
+    BENCH_STRICT_EXTRAS=1."""
+    import http.client
+    import socket
+    import threading
+
+    from predictionio_tpu.common import history
+    from predictionio_tpu.common import telemetry as _telemetry
+    from predictionio_tpu.data.api.http import make_server
+    from predictionio_tpu.workflow.create_server import QueryAPI, ServerConfig
+
+    def leg(history_on: bool):
+        _telemetry.set_enabled(True)
+        history.set_enabled(history_on)
+        history.reset()
+        # bench-fast sampler cadence so a sub-minute burst still lands
+        # several ring entries (production default is 5 s)
+        history.install(history.HistoryConfig(tick_s=0.1))
+        try:
+            api = QueryAPI(storage=storage, engine=engine,
+                           config=ServerConfig(batching="on"))
+            server = make_server(api, "127.0.0.1", 0)
+            port = server.server_address[1]
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            lat_lock = threading.Lock()
+            lat: list = []
+            errors: list = []
+            barrier = threading.Barrier(n_conns + 1)
+
+            def client(cx):
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    my = []
+                    barrier.wait()
+                    for q in range(queries_per_client):
+                        body = json.dumps(
+                            {"user": f"u{(cx * 131 + q * 17) % 1000}",
+                             "num": 10})
+                        t0 = time.perf_counter()
+                        conn.request(
+                            "POST", "/queries.json", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        my.append(time.perf_counter() - t0)
+                        assert resp.status == 200, payload[:200]
+                    conn.close()
+                    with lat_lock:
+                        lat.extend(my)
+                except Exception as e:
+                    errors.append(e)
+
+            hist_body = None
+            try:
+                threads = [threading.Thread(target=client, args=(cx,))
+                           for cx in range(n_conns)]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                # the mid-burst read: the endpoint must answer while
+                # the serving path is under load and the sampler ticks
+                time.sleep(0.3)
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.request("GET", "/debug/history.json?limit=64")
+                resp = conn.getresponse()
+                assert resp.status == 200, "history.json read failed"
+                hist_body = json.loads(resp.read().decode("utf-8"))
+                conn.close()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+            finally:
+                server.shutdown()
+                api.close()
+            lat_ms = np.asarray(lat) * 1e3
+            return {"p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                    }, hist_body
+        finally:
+            _telemetry.set_enabled(None)
+            history.set_enabled(None)
+            history.reset()
+
+    off, off_hist = leg(False)
+    on, on_hist = leg(True)
+    if off_hist is None or off_hist.get("enabled") is not False:
+        raise RuntimeError("history-off leg still reports an enabled "
+                           f"recorder: {off_hist}")
+    samples = (on_hist or {}).get("samples") or []
+    served = [
+        e for e in samples
+        if any(history.series_family(k) == "pio_serve_seconds"
+               and isinstance(v, dict) and v.get("count", 0) > 0
+               for k, v in (e.get("series") or {}).items())]
+    if not served:
+        raise RuntimeError(
+            "history-on leg's mid-burst /debug/history.json carried no "
+            f"pio_serve_seconds deltas ({len(samples)} sample(s))")
+    series_total = int(on_hist.get("seriesTotal") or 0)
+    max_series = history.HistoryConfig.from_env().max_series
+    if series_total > max_series:
+        raise RuntimeError(
+            f"recorder tracks {series_total} series, over the "
+            f"PIO_HISTORY_MAX_SERIES cap {max_series} — unbounded")
+    overhead_ok = (on["p99_ms"] <= off["p99_ms"] * 1.05
+                   or on["p99_ms"] - off["p99_ms"] <= 0.2)
+    return {
+        "history_off": off,
+        "history_on": on,
+        "history_on_p99_ms": on["p99_ms"],
+        "history_overhead_p99_pct": round(
+            (on["p99_ms"] / max(off["p99_ms"], 1e-9) - 1.0) * 100, 2),
+        "history_overhead_ok": bool(overhead_ok),
+        "history_series_total": series_total,
+        "history_midburst_samples": len(samples),
+        "history_dropped_series": int(on_hist.get("droppedSeries") or 0),
+    }
+
+
 def measure_foldin(storage, engine, n_conns: int = 8,
                    queries_per_client: int = 60, n_fresh_users: int = 12):
     """Realtime fold-in leg (realtime/foldin.py): the same batched
@@ -3532,6 +3669,18 @@ def main() -> None:
             except Exception as e:
                 jrnl = {"journal_error": f"{type(e).__name__}: {e}"}
 
+        # metrics-flight-recorder leg (common/history.py): history off
+        # vs on through the same batched path + a MID-BURST
+        # /debug/history.json read; sampling runs off-thread, so the
+        # on-p99 tax gates at <= 5% under strict extras and the rings
+        # must hold pio_serve_seconds deltas and stay bounded
+        hist_leg = None
+        if os.environ.get("BENCH_SKIP_THROUGHPUT") != "1":
+            try:
+                hist_leg = measure_history(storage, engine)
+            except Exception as e:
+                hist_leg = {"history_error": f"{type(e).__name__}: {e}"}
+
         # realtime fold-in leg (realtime/foldin.py): serve p99 with the
         # worker off vs on (live event stream in the on leg, <= 5%
         # strict gate) + wire-level freshness for unseen users (p99
@@ -3796,6 +3945,7 @@ def main() -> None:
                 **(telem or {}),
                 **(wf or {}),
                 **(jrnl or {}),
+                **(hist_leg or {}),
                 **(foldin_leg or {}),
                 **(shard_leg or {}),
                 **(quant_leg or {}),
@@ -3937,6 +4087,18 @@ def main() -> None:
                     f"({jrnl['journal_on']['p99_ms']} ms) exceeds "
                     "journal-off "
                     f"({jrnl['journal_off']['p99_ms']} ms) by >5% "
+                    "with BENCH_STRICT_EXTRAS=1")
+        if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and hist_leg:
+            if hist_leg.get("history_error"):
+                failures.append(
+                    f"history leg crashed ({hist_leg['history_error']}) "
+                    "with BENCH_STRICT_EXTRAS=1")
+            elif not hist_leg.get("history_overhead_ok"):
+                failures.append(
+                    "history-on p99 "
+                    f"({hist_leg['history_on']['p99_ms']} ms) exceeds "
+                    "history-off "
+                    f"({hist_leg['history_off']['p99_ms']} ms) by >5% "
                     "with BENCH_STRICT_EXTRAS=1")
         if os.environ.get("BENCH_STRICT_EXTRAS") == "1" and foldin_leg:
             if foldin_leg.get("foldin_error"):

@@ -330,6 +330,16 @@ ENV_VARS: Dict[str, str] = {
     "PIO_JOURNAL_BUFFER":
         "journal ring capacity in events (default 1024; seq numbers "
         "stay monotonic across eviction)",
+    "PIO_HISTORY":
+        "0 disables the metrics flight recorder (bounded in-process "
+        "time-series rings; default on — /debug/history.json then "
+        "answers enabled:false with no samples)",
+    "PIO_HISTORY_TICK_S":
+        "history sampler cadence in seconds (default 5; floor 0.1) — "
+        "also the fast ring's resolution",
+    "PIO_HISTORY_MAX_SERIES":
+        "series the history rings will track before dropping new ones "
+        "(default 512; drops are counted, memory stays bounded)",
     "PIO_WATERFALL":
         "1 samples per-request latency waterfalls into "
         "pio_serve_stage_seconds + /debug/slow.json (default 0)",
@@ -581,6 +591,15 @@ METRICS: Dict[str, str] = {
     "pio_journal_events_total":
         "operational journal events by category and level (the events "
         "themselves ride /debug/events.json)",
+    "pio_history_ticks_total":
+        "sampler passes the metrics flight recorder completed (the "
+        "rings themselves ride /debug/history.json)",
+    "pio_history_series":
+        "series the flight recorder currently tracks (bounded by "
+        "PIO_HISTORY_MAX_SERIES)",
+    "pio_history_dropped_series_total":
+        "series refused by the PIO_HISTORY_MAX_SERIES cap (bounded "
+        "memory beats complete coverage)",
     # ---------------------------------------------------------------- SLO
     "pio_slo_target": "configured SLO objective (collector)",
     "pio_slo_error_budget_remaining":

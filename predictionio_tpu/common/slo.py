@@ -31,9 +31,11 @@ parity):
 
 Burn thresholds follow the SRE Workbook pages: fast-window burn >= 14.4
 is the page (`pio doctor` goes RED), slow-window burn >= 6 is the
-ticket (WARN). Windowed rates come from a bounded history of scrape
-snapshots — the engine records (monotonic time, good, total) per
-objective each scrape and differences against the snapshot just outside
+ticket (WARN). Windowed rates come from a bounded ring of snapshots
+(:class:`history.SnapshotRing` — the metrics flight recorder owns the
+bookkeeping and its sampler thread feeds the rings between scrapes, one
+snapshotter per process): the engine records (monotonic time, good,
+total) per objective and differences against the snapshot just outside
 the window, so any scraper cadence works and an idle window burns 0.
 
 Targets come from ``ServerConfig`` (``pio deploy --slo-availability /
@@ -47,10 +49,9 @@ import dataclasses
 import os
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-from predictionio_tpu.common import telemetry
+from predictionio_tpu.common import history, telemetry
 
 #: SRE Workbook multiwindow thresholds: page on fast burn, ticket on slow
 FAST_BURN_RED = 14.4
@@ -175,39 +176,36 @@ class SLOEngine:
     def __init__(self, config: Optional[SLOConfig] = None):
         self.config = config or SLOConfig.from_env()
         self._lock = threading.Lock()
-        #: per-objective deque of (monotonic_s, good, total)
-        self._history: Dict[str, deque] = {
-            "availability": deque(maxlen=4096),
-            "latency": deque(maxlen=4096),
+        #: per-objective snapshot ring of (monotonic_s, good, total) —
+        #: the bookkeeping lives in history.SnapshotRing so the metrics
+        #: flight recorder's sampler thread (one snapshotter per
+        #: process) keeps these warm between scrapes via
+        #: :meth:`record_snapshot`; the differencing math is unchanged
+        self._history: Dict[str, history.SnapshotRing] = {
+            "availability": history.SnapshotRing(maxlen=4096),
+            "latency": history.SnapshotRing(maxlen=4096),
         }
         #: (slo, window) -> currently over its burn threshold; edge
         #: transitions (not levels) land in the operational journal
         self._hot: Dict[Tuple[str, str], bool] = {}
 
     # -------------------------------------------------------------- windows
-    def _window_rate(self, history: deque, now: float, good: float,
-                     total: float, window_s: float) -> float:
-        """Observed BAD fraction over the trailing window (0 when the
-        window saw no traffic). A brand-new engine (no snapshot yet)
-        claims NO burn rather than judging the process's whole lifetime
-        as one window — the baseline forms at the first scrape and real
-        rates start at the second."""
-        if not history:
-            return 0.0
-        base: Optional[Tuple[float, float, float]] = None
-        for t, g, n in reversed(history):
-            if now - t >= window_s:
-                base = (t, g, n)
-                break
-        if base is None:
-            # window extends past recorded history: difference against
-            # the oldest snapshot (partial-window coverage)
-            base = history[0]
-        d_total = total - base[2]
-        if d_total <= 0:
-            return 0.0
-        d_bad = (total - good) - (base[2] - base[1])
-        return max(0.0, d_bad / d_total)
+    def record_snapshot(self, now: Optional[float] = None) -> None:
+        """Append one (t, good, total) snapshot per objective WITHOUT
+        evaluating burn or journaling — the history sampler's per-tick
+        feed. Scrape-time :meth:`evaluate` gets real window bases even
+        when nothing scraped for an hour."""
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        counts = {
+            "availability": _availability_counts(),
+            "latency": _latency_counts(cfg.latency_ms / 1e3),
+        }
+        with self._lock:
+            for slo, (good, total) in counts.items():
+                ring = self._history[slo]
+                ring.append(now, good, total)
+                ring.prune(now, cfg.slow_window_s)
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
         """Evaluate both objectives, append the snapshot, and return
@@ -223,19 +221,17 @@ class SLOEngine:
         out: Dict[str, Any] = {}
         with self._lock:
             for slo, ((good, total), target) in counts.items():
-                history = self._history[slo]
+                ring = self._history[slo]
                 allowed = max(1.0 - target, 1e-9)
                 bad_ratio = ((total - good) / total) if total > 0 else 0.0
-                fast = self._window_rate(history, now, good, total,
-                                         cfg.fast_window_s) / allowed
-                slow = self._window_rate(history, now, good, total,
-                                         cfg.slow_window_s) / allowed
-                history.append((now, good, total))
+                fast = ring.window_rate(now, good, total,
+                                        cfg.fast_window_s) / allowed
+                slow = ring.window_rate(now, good, total,
+                                        cfg.slow_window_s) / allowed
+                ring.append(now, good, total)
                 # prune entries older than the slow window (plus one
                 # kept just outside it as the differencing base)
-                while (len(history) > 2
-                       and now - history[1][0] > cfg.slow_window_s):
-                    history.popleft()
+                ring.prune(now, cfg.slow_window_s)
                 out[slo] = {
                     "target": target,
                     "good": good,

@@ -125,11 +125,14 @@ class EventAPI:
         # device observability on this daemon's /metrics and
         # /debug/device.json too (the event server rarely compiles, but
         # the operator's scrape surface is uniform; idempotent)
-        from predictionio_tpu.common import devicewatch, slo
+        from predictionio_tpu.common import devicewatch, history, slo
         devicewatch.install()
         # SLO burn-rate gauges (env-default targets; a query server in
         # the same process installs its configured targets over these)
         slo.install()
+        # metrics flight recorder: /debug/history.json rings (one
+        # sampler thread per process; idempotent)
+        history.install()
 
     # ------------------------------------------------------------------ auth
     def _authenticate(self, query: Dict[str, str],

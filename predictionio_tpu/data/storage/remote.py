@@ -120,11 +120,14 @@ class StorageRPCAPI:
         self._dedup_lock = threading.Lock()
         # uniform device-observability surface (/metrics gauges +
         # /debug/device.json) on the storage daemon as well (idempotent)
-        from predictionio_tpu.common import devicewatch, slo
+        from predictionio_tpu.common import devicewatch, history, slo
         devicewatch.install()
         # SLO burn-rate gauges (env-default targets; a query server in
         # the same process installs its configured targets over these)
         slo.install()
+        # metrics flight recorder: /debug/history.json rings (one
+        # sampler thread per process; idempotent)
+        history.install()
 
     # -- per-DAO method tables, each entry: args-dict -> JSON-able ----------
     def _events(self, m: str, a: Dict[str, Any]):

@@ -39,6 +39,8 @@ DAEMON_MODULES = (
     "predictionio_tpu/data/api/service.py",         # event (EventAPI)
     "predictionio_tpu/data/storage/remote.py",      # storage (RPC API)
     "predictionio_tpu/workflow/router.py",          # fleet (RouterAPI)
+    "predictionio_tpu/tools/dashboard.py",          # eval (DashboardAPI)
+    "predictionio_tpu/tools/admin.py",              # admin (AdminAPI)
 )
 
 

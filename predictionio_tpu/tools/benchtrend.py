@@ -176,6 +176,14 @@ METRICS: Tuple[Tuple[str, str, Any], ...] = (
     # serving path's job for it
     ("autotrain_cycle_s", "down", False),
     ("autotrain_candidates_rejected", "down", False),
+    # metrics-flight-recorder era (common/history.py): the sampler's
+    # serve-p99 tax with history on vs off (hard-gated at <= 5% by the
+    # bench's history leg under BENCH_STRICT_EXTRAS=1 — the hot path
+    # pays nothing) and the series the rings track — coverage of the
+    # metric surface, bounded by PIO_HISTORY_MAX_SERIES (the bench leg
+    # hard-fails if the cap is ever exceeded)
+    ("history_overhead_p99_pct", "down", False),
+    ("history_series_total", "up", False),
 )
 
 #: absolute ceilings (metric -> limit), enforced on the NEWEST round

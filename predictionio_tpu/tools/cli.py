@@ -380,6 +380,38 @@ def cmd_events(args) -> int:
         timeout=args.timeout)
 
 
+def cmd_monitor(args) -> int:
+    """One-screen auto-refreshing fleet view (tools/monitor.py): per
+    target QPS, p99 and error rate derived from each daemon's OWN
+    metrics-history rings (/debug/history.json), SLO burn from live
+    gauges, plus the doctor-tier state flags (breakers, partition
+    gaps, autopilot, fold-in lag). --once prints one frame; --record
+    FILE appends each frame as a JSON line (the durable path out of
+    the bounded per-process rings); --replay FILE re-renders a
+    recording offline. Exit 0 / 2 all targets unreachable."""
+    from predictionio_tpu.tools.monitor import run_monitor
+    if args.replay:
+        return run_monitor([], replay=args.replay,
+                           interval_s=args.interval)
+    return run_monitor(
+        _parse_targets(args.targets), once=args.once,
+        interval_s=args.interval, record=args.record or None,
+        timeout=args.timeout)
+
+
+def cmd_incident(args) -> int:
+    """One ordered incident timeline for a fleet (tools/incident.py):
+    journal WARN/RED events, metric change-points (rolling median +
+    MAD step detection over each target's history rings), slow-ring
+    exemplars, and any referenced traces — fused, clock-skew corrected
+    via trace pairing, oldest first. Exit 0 clean window / 1 incident
+    evidence found / 2 all targets unreachable."""
+    from predictionio_tpu.tools.incident import run_incident
+    return run_incident(
+        _parse_targets(args.targets), window=args.window,
+        trace_id=args.trace or None, timeout=args.timeout)
+
+
 def cmd_lint(args) -> int:
     """Repo-wide static analysis (tools/analyze): the KNOWN_ISSUES
     invariants as lint passes — timing honesty, implicit host syncs,
@@ -1066,6 +1098,48 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-target timeout in seconds")
 
     sp = sub.add_parser(
+        "monitor",
+        help="one-screen auto-refreshing fleet view: QPS, p99, error "
+             "rate and SLO burn per target from each daemon's metrics "
+             "history rings (/debug/history.json; exit 0 / 2 when "
+             "every target is unreachable)")
+    sp.add_argument("--targets", default="",
+                    help="comma-separated daemon base URLs (router + "
+                         "replicas + storage)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scripting)")
+    sp.add_argument("--interval", type=float, default=5.0,
+                    help="refresh interval in seconds")
+    sp.add_argument("--record", default="",
+                    help="append every frame's raw fetches to FILE as "
+                         "JSON lines — the durable path out of the "
+                         "bounded per-process rings (KNOWN_ISSUES #20)")
+    sp.add_argument("--replay", default="",
+                    help="re-render a --record file frame by frame "
+                         "without touching the network")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-target timeout in seconds")
+
+    sp = sub.add_parser(
+        "incident",
+        help="assemble one ordered incident timeline from a fleet: "
+             "journal events + metric change-points (history rings) + "
+             "slow exemplars + referenced traces, clock-skew "
+             "corrected (exit 0 clean / 1 evidence found / 2 "
+             "unreachable)")
+    sp.add_argument("--targets", required=True,
+                    help="comma-separated daemon base URLs")
+    sp.add_argument("--window", default="10m",
+                    help="lookback window, e.g. 10m / 90s / 1h "
+                         "(default 10m)")
+    sp.add_argument("--trace", default="",
+                    help="seed the assembly with this trace id "
+                         "(otherwise traces referenced by journal "
+                         "events / slow exemplars are fetched)")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-target timeout in seconds")
+
+    sp = sub.add_parser(
         "lint",
         help="repo-wide static analysis of the KNOWN_ISSUES invariants "
              "(tools/analyze; exit 0 clean / 1 findings / 2 internal "
@@ -1292,6 +1366,8 @@ _DISPATCH = {
     "undeploy": cmd_undeploy,
     "foldin": cmd_foldin,
     "doctor": cmd_doctor,
+    "monitor": cmd_monitor,
+    "incident": cmd_incident,
     "trace": cmd_trace,
     "events": cmd_events,
     "lint": cmd_lint,

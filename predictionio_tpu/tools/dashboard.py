@@ -23,17 +23,33 @@ class DashboardAPI:
         from predictionio_tpu.common.server_security import KeyAuth
         self.storage = storage if storage is not None else get_storage()
         self.auth = KeyAuth(server_key)
+        from predictionio_tpu.common import devicewatch, history, slo
+        devicewatch.install()
+        slo.install()
+        # metrics flight recorder (one sampler thread per process)
+        history.install()
 
     def handle(self, method: str, path: str,
                query: Optional[Dict[str, str]] = None,
                body: bytes = b"",
                headers: Optional[Dict[str, str]] = None) -> Response:
+        method = method.upper()
+        path = (path or "/").rstrip("/") or "/"
+        # probes + telemetry surface answer before auth, like every
+        # other daemon: a scraper or `pio monitor` holds no key
+        if path == "/healthz" and method == "GET":
+            return 200, {"status": "ok"}
+        from predictionio_tpu.common import telemetry
+        headers = headers or {}
+        t = telemetry.handle_route(
+            method, path, query,
+            accept=headers.get("accept") or headers.get("Accept"))
+        if t is not None:   # /metrics, /traces.json, /debug/*.json
+            return t
         # KeyAuthentication.scala parity: reject before routing
         rejected = self.auth.gate(headers, query)
         if rejected is not None:
             return rejected
-        method = method.upper()
-        path = (path or "/").rstrip("/") or "/"
         if method != "GET":
             return 405, {"message": "method not allowed"}
         if path == "/":

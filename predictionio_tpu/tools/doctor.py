@@ -169,6 +169,7 @@ def scrape(base_url: str, timeout: float = 5.0) -> Dict[str, Any]:
                       ("traces", "/traces.json?limit=8"),
                       ("device", "/debug/device.json"),
                       ("slow", "/debug/slow.json?limit=3"),
+                      ("history", "/debug/history.json?limit=24"),
                       ("events", "/debug/events.json?level=warn&limit=8")):
         status, body = _get(base_url, path, timeout)
         out[key] = {"status": status, "body": body}
@@ -818,6 +819,21 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
             checks.append(("waterfall", OK,
                            "sampling on, no requests recorded yet"))
 
+    # trend (common/history.py metrics flight recorder) ----------------
+    # WARN only, by design: the point-in-time checks above own RED —
+    # this line says which way the last few minutes were MOVING
+    # (sustained p99 climb, QPS collapse) from the daemon's own rings
+    hist = _json_body(scraped.get("history", {}))
+    if hist is None:
+        checks.append(("trend", NA, "no /debug/history.json "
+                       "(old daemon?)"))
+    elif not hist.get("enabled"):
+        checks.append(("trend", NA,
+                       "history off (PIO_HISTORY=0) — no trend data"))
+    else:
+        trend_state, trend_detail = _trend(hist)
+        checks.append(("trend", trend_state, trend_detail))
+
     # recent operational events (common/journal.py flight recorder) ----
     # the alarm -> timeline link: the last WARN/RED journal entries with
     # ages, so every RED check above has its "when did this start"
@@ -853,6 +869,57 @@ def diagnose(scraped: Dict[str, Any]) -> List[Tuple[str, str, str]]:
             checks.append(("events", WARN if hot else OK,
                            f"last {len(recent)} WARN/RED: {detail}"))
     return checks
+
+
+#: trend thresholds: last-third p99 this much over the first third is
+#: a sustained climb; last-entry QPS under this fraction of the
+#: earlier median is a collapse
+_TREND_P99_CLIMB = 2.0
+_TREND_QPS_COLLAPSE = 0.2
+#: points per third before the trend line speaks at all
+_TREND_MIN_POINTS = 2
+
+
+def _trend(hist: Dict[str, Any]) -> Tuple[str, str]:
+    """(state, detail) for the trend check, from a history.json body."""
+    from predictionio_tpu.common import history as _hist
+    samples = hist.get("samples") or []
+    tick_s = float(hist.get("tickS") or 5.0)
+    qps = _hist.count_points(samples, "pio_serve_seconds", tick_s)
+    if not qps:
+        qps = _hist.rate_points(samples, "pio_http_requests_total",
+                                tick_s)
+    p99 = _hist.quantile_points(samples, "pio_serve_seconds", 0.99)
+    if not p99:
+        p99 = _hist.quantile_points(samples, "pio_http_request_seconds",
+                                    0.99)
+    span_s = ((samples[-1]["t"] - samples[0]["t"]) / 1e3
+              if len(samples) >= 2 else 0.0)
+    if len(qps) < 3 * _TREND_MIN_POINTS and len(p99) < 3 * _TREND_MIN_POINTS:
+        return NA, (f"{len(samples)} history tick(s) — not enough for "
+                    "a trend yet")
+    warns = []
+    if len(p99) >= 3 * _TREND_MIN_POINTS:
+        third = len(p99) // 3
+        first = sum(v for _t, v in p99[:third]) / third
+        last = sum(v for _t, v in p99[-third:]) / third
+        if first > 0 and last / first >= _TREND_P99_CLIMB:
+            warns.append(f"serve p99 climbing: {first * 1e3:.1f} ms -> "
+                         f"{last * 1e3:.1f} ms over ~{span_s:.0f} s")
+    if len(qps) >= 3 * _TREND_MIN_POINTS:
+        earlier = sorted(v for _t, v in qps[:-_TREND_MIN_POINTS])
+        med = earlier[len(earlier) // 2]
+        recent = sum(v for _t, v in qps[-_TREND_MIN_POINTS:]) \
+            / _TREND_MIN_POINTS
+        if med > 0 and recent <= med * _TREND_QPS_COLLAPSE:
+            warns.append(f"QPS collapsed: ~{med:.1f}/s -> "
+                         f"{recent:.1f}/s")
+    if warns:
+        return WARN, ("; ".join(warns)
+                      + " — pio incident --targets <url> for the "
+                      "timeline")
+    return OK, (f"steady over ~{span_s:.0f} s "
+                f"({len(samples)} tick(s))")
 
 
 def _age(ts: Optional[float], now: float) -> str:

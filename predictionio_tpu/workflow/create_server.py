@@ -31,7 +31,8 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.common import (
-    devicewatch, journal, resilience, slo, telemetry, tracing, waterfall,
+    devicewatch, history, journal, resilience, slo, telemetry, tracing,
+    waterfall,
 )
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.controller.persistent_model import PersistentModelManifest
@@ -354,6 +355,9 @@ class QueryAPI:
             availability=self.config.slo_availability,
             latency_ms=self.config.slo_latency_ms,
             latency_target=self.config.slo_latency_target))
+        # metrics flight recorder: bounded time-series rings behind
+        # /debug/history.json (one sampler thread per process)
+        history.install()
         #: wall-clock from construction to servable (model loaded, AOT
         #: prebuild done) — the metric the <10 s warm-replica gate reads
         self.time_to_ready_s: Optional[float] = None

@@ -513,9 +513,11 @@ class RouterAPI:
         self.shed_count = 0
         self.failover_count = 0
         # uniform daemon observability surface (idempotent)
-        from predictionio_tpu.common import devicewatch, slo
+        from predictionio_tpu.common import devicewatch, history, slo
         devicewatch.install()
         slo.install()
+        # metrics flight recorder (one sampler thread per process)
+        history.install()
         reg = telemetry.registry()
         self._m_requests = reg.counter(
             "pio_router_requests_total",

@@ -1,0 +1,461 @@
+"""Metrics flight recorder (common/history.py) + its two consumers
+(`pio monitor`, `pio incident`).
+
+Covers the acceptance surface: ring mechanics (counter deltas with
+baseline + reset semantics, histogram bucket deltas, gauge last-value,
+fast->slow downsampling, bounded memory under PIO_HISTORY_MAX_SERIES),
+the /debug/history.json route (param validation, WIRE PARITY with
+history off — existing responses byte-identical, the endpoint answers
+``enabled: false``), the SLO engine riding the shared sampler without
+its burn math changing, monitor --once/--record/--replay, and the
+incident e2e: a fault injected into two live daemons shows up as one
+ordered timeline fusing the journal RED, the p99 change-point and the
+trace's spans.
+"""
+
+import io
+import json
+import urllib.request
+from datetime import datetime, timezone
+
+import pytest
+
+from journal_test_util import trained_query_api
+from predictionio_tpu.common import (
+    history, journal, slo, telemetry, tracing,
+)
+from predictionio_tpu.data.api import EventAPI
+from predictionio_tpu.data.api.http import serve_background
+from predictionio_tpu.tools import incident, monitor
+from predictionio_tpu.tools.cli import build_parser
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    for mod in (telemetry, journal, tracing, history):
+        mod.set_enabled(None)
+    journal.clear()
+    tracing.clear()
+    history.reset()
+    slo.reset()
+    yield
+    for mod in (telemetry, journal, tracing, history):
+        mod.set_enabled(None)
+    journal.clear()
+    tracing.clear()
+    history.reset()
+    slo.reset()
+
+
+@pytest.fixture()
+def fresh_registry(monkeypatch):
+    """An empty process registry so the rings hold exactly the series
+    this test writes (the real registry is additive process-wide)."""
+    reg = telemetry.MetricsRegistry()
+    monkeypatch.setattr(telemetry, "REGISTRY", reg)
+    return reg
+
+
+def _now_ms():
+    return int(datetime.now(timezone.utc).timestamp() * 1000)
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+def test_counter_deltas_baseline_and_reset(fresh_registry):
+    """First sight baselines at 0 (the counter's past predates the
+    ring); going backwards is a reset and the delta restarts from the
+    new value instead of going negative."""
+    rec = history.Recorder(history.HistoryConfig())
+    c = telemetry.registry().counter("demo_total", "d").child()
+    c.inc(10)
+    rec.tick(wall_ms=1000)
+    c.inc(3)
+    rec.tick(wall_ms=6000)
+    snap = rec.snapshot()
+    assert [e["series"]["demo_total"] for e in snap["samples"]] == \
+        [0.0, 3.0]
+    assert snap["kinds"]["demo_total"] == "counter"
+    # reset semantics, unit-level: 10 -> 13 -> 2 (restarted process)
+    assert rec._counter_delta("k", 10.0) == 0.0
+    assert rec._counter_delta("k", 13.0) == 3.0
+    assert rec._counter_delta("k", 2.0) == 2.0
+
+
+def test_histogram_bucket_deltas(fresh_registry):
+    """Each tick's entry is a tiny cumulative histogram of just that
+    tick's observations; the baseline tick records nothing (no prior
+    pass to difference against)."""
+    rec = history.Recorder(history.HistoryConfig())
+    h = telemetry.registry().histogram("demo_seconds", "d").labels()
+    h.observe(0.01)
+    rec.tick(wall_ms=1000)
+    for _ in range(5):
+        h.observe(0.01)
+    h.observe(1.0)
+    rec.tick(wall_ms=6000)
+    first, second = rec.snapshot()["samples"]
+    assert "demo_seconds" not in first["series"]
+    d = second["series"]["demo_seconds"]
+    assert d["count"] == 6
+    assert d["sum"] == pytest.approx(5 * 0.01 + 1.0)
+    assert d["buckets"]["+Inf"] == 6
+    # count going backwards = reset, tolerated like a counter's
+    out = rec._hist_delta("k", {"buckets": {0.1: 5.0, float("inf"): 5.0},
+                                "sum": 0.05, "count": 5.0})
+    assert out is None                       # baseline
+    out = rec._hist_delta("k", {"buckets": {0.1: 2.0, float("inf"): 2.0},
+                                "sum": 0.02, "count": 2.0})
+    assert out["count"] == 2.0               # not -3
+
+
+def test_downsample_merge_counters_sum_gauges_last(fresh_registry):
+    """A slow slot is the fold of its fast ticks: counter + histogram
+    deltas SUM (a 60 s delta is the sum of its 5 s deltas), gauges keep
+    the last value, and the slot is stamped with the last tick's t."""
+    cfg = history.HistoryConfig(slow_every=3)
+    rec = history.Recorder(cfg)
+    reg = telemetry.registry()
+    c = reg.counter("m_total", "d").child()
+    g = reg.gauge("m_gauge", "d").child()
+    h = reg.histogram("m_seconds", "d").labels()
+    for i, (inc, gv, obs) in enumerate(
+            [(5, 1.0, 2), (7, 2.0, 3), (9, 7.0, 4)]):
+        c.inc(inc)
+        g.set(gv)
+        for _ in range(obs):
+            h.observe(0.01)
+        rec.tick(wall_ms=1000 + i * 5000)
+    slow = rec.snapshot(res="slow")
+    assert len(slow["samples"]) == 1
+    slot = slow["samples"][0]
+    assert slot["t"] == 11000
+    s = slot["series"]
+    assert s["m_total"] == 7.0 + 9.0         # tick 1 was the baseline
+    assert s["m_gauge"] == 7.0
+    assert s["m_seconds"]["count"] == 3 + 4  # baseline tick recorded none
+
+
+def test_series_cap_drops_not_grows(fresh_registry):
+    """PIO_HISTORY_MAX_SERIES is a hard cap: series beyond it are
+    counted as dropped, never admitted (bounded memory beats complete
+    coverage, KNOWN_ISSUES #20)."""
+    rec = history.Recorder(history.HistoryConfig(max_series=3))
+    fam = telemetry.registry().counter("many_total", "d",
+                                       labelnames=("i",))
+    for i in range(8):
+        fam.labels(i=str(i)).inc()
+    rec.tick(wall_ms=1000)
+    snap = rec.snapshot()
+    assert snap["seriesTotal"] == 3
+    assert snap["droppedSeries"] == 5
+    assert len(snap["samples"][0]["series"]) == 3
+
+
+def test_snapshot_series_since_ms_and_limit_filters(fresh_registry):
+    rec = history.Recorder(history.HistoryConfig())
+    reg = telemetry.registry()
+    a = reg.counter("aaa_total", "d").child()
+    reg.gauge("bbb_gauge", "d").child().set(1.0)
+    for i in range(3):
+        a.inc()
+        rec.tick(wall_ms=1000 + i * 5000)
+    snap = rec.snapshot(series="aaa_total", since_ms=1000)
+    assert [e["t"] for e in snap["samples"]] == [6000, 11000]
+    assert all(set(e["series"]) == {"aaa_total"}
+               for e in snap["samples"])
+    assert set(snap["kinds"]) == {"aaa_total"}
+    snap = rec.snapshot(limit=1)
+    assert [e["t"] for e in snap["samples"]] == [11000]
+
+
+# ---------------------------------------------------------------------------
+# the route: validation + wire parity off
+# ---------------------------------------------------------------------------
+
+def test_history_route_param_validation(fresh_registry):
+    history.install(start=False)
+    st, body = telemetry.handle_route(
+        "GET", "/debug/history.json", {"since_ms": "nope"})
+    assert st == 400 and "since_ms" in body["message"]
+    st, body = telemetry.handle_route(
+        "GET", "/debug/history.json", {"res": "bogus"})
+    assert st == 400 and "res must be fast or slow" in body["message"]
+    st, body = telemetry.handle_route(
+        "GET", "/debug/history.json", {"limit": "many"})
+    assert st == 400 and "limit" in body["message"]
+    # clamped, not rejected: an over-ask is a full read
+    st, body = telemetry.handle_route(
+        "GET", "/debug/history.json", {"limit": "999999", "res": "slow"})
+    assert st == 200 and body["res"] == "slow"
+    st, body = telemetry.handle_route("GET", "/debug/history.json", {})
+    assert st == 200
+    assert body["enabled"] is True
+    assert body["retention"]["slow"]["slots"] == history.SLOW_SLOTS
+
+
+def test_wire_parity_history_off(memory_storage):
+    """PIO_HISTORY=0: existing endpoints' bytes are unchanged (history
+    only ever ADDS /debug/history.json, which then answers
+    enabled:false), and a disabled tick records nothing."""
+    api = trained_query_api(memory_storage)
+    server, port = serve_background(api)
+    body = json.dumps({"user": "u1", "num": 3}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            f"http://localhost:{port}/queries.json", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read()
+
+    try:
+        history.set_enabled(True)
+        st_on, bytes_on = post()
+        history.set_enabled(False)
+        st_off, bytes_off = post()
+        assert st_on == st_off == 200
+        assert bytes_on == bytes_off
+        # off stops RECORDING; the rings keep what they had but nothing
+        # new lands while disabled
+        rec = history.recorder()
+        assert rec is not None               # QueryAPI installed it
+        ticks_before = rec.snapshot()["ticksTotal"]
+        rec.tick(wall_ms=_now_ms())          # must no-op
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/history.json") as r:
+            snap = json.loads(r.read())
+        assert snap["enabled"] is False
+        assert snap["samples"] == []
+        assert rec.snapshot()["ticksTotal"] == ticks_before
+    finally:
+        server.shutdown()
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine rides the shared sampler
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_unchanged_by_sampler_snapshots(fresh_registry):
+    """record_snapshot calls between scrapes (what the history sampler
+    does every tick) must not change the burn verdicts — same numbers
+    as test_slo.py's test_availability_burn_and_budget."""
+    eng = slo.SLOEngine(slo.SLOConfig(availability=0.999,
+                                      fast_window_s=60.0,
+                                      slow_window_s=600.0))
+    fam = telemetry.registry().counter(
+        "pio_http_requests_total", "req",
+        labelnames=("service", "status"))
+    c_ok = fam.labels(service="H1", status="200")
+    c_bad = fam.labels(service="H1", status="500")
+    c_ok.inc(1000)
+    eng.evaluate(now=0.0)                    # baseline snapshot
+    c_ok.inc(950)
+    c_bad.inc(50)
+    eng.record_snapshot(now=50.0)            # sampler ticks, inside
+    eng.record_snapshot(now=99.0)            # both burn windows
+    v = eng.evaluate(now=100.0)["availability"]
+    assert v["burn_fast"] == pytest.approx(0.05 / 0.001, rel=1e-6)
+    assert v["burn_slow"] == pytest.approx(0.05 / 0.001, rel=1e-6)
+    assert v["budget_remaining"] == pytest.approx(1 - 0.025 / 0.001,
+                                                  rel=1e-6)
+
+
+def test_history_tick_feeds_slo_rings(fresh_registry):
+    """The sampler is the process's one snapshotter: a recorder tick
+    appends to the installed SLO engine's windows."""
+    eng = slo.install(slo.SLOConfig())
+    rec = history.install(start=False)
+    before = {k: len(r) for k, r in eng._history.items()}
+    rec.tick(wall_ms=1000)
+    for k, ring in eng._history.items():
+        assert len(ring) == before[k] + 1, k
+
+
+# ---------------------------------------------------------------------------
+# pio monitor: --once / --record / --replay
+# ---------------------------------------------------------------------------
+
+def _ticked_daemon(memory_storage, obs_per_tick=20, ticks=2):
+    """A live EventAPI whose history rings hold deterministic serve
+    traffic: ``obs_per_tick`` 10 ms observations per 5 s tick."""
+    api = EventAPI(storage=memory_storage)
+    server, port = serve_background(api)
+    history.reset()                          # drop the ctor's sampler
+    rec = history.install(history.HistoryConfig(), start=False)
+    h = telemetry.registry().histogram(
+        "pio_serve_seconds", "serve", labelnames=("mode",)
+    ).labels(mode="batched")
+    t0 = _now_ms() - (ticks + 1) * 5000
+    rec.tick(wall_ms=t0)                     # baseline
+    for i in range(ticks):
+        for _ in range(obs_per_tick):
+            h.observe(0.01)
+        rec.tick(wall_ms=t0 + (i + 1) * 5000)
+    return api, server, port, rec
+
+
+def test_monitor_once_live(memory_storage, fresh_registry):
+    api, server, port, _rec = _ticked_daemon(memory_storage)
+    buf = io.StringIO()
+    try:
+        rc = monitor.run_monitor([f"http://localhost:{port}"],
+                                 once=True, out=buf)
+    finally:
+        server.shutdown()
+    out = buf.getvalue()
+    assert rc == 0
+    assert f"http://localhost:{port}" in out
+    # 20 obs / 5 s tick -> 4.0 qps straight off the histogram deltas
+    assert "4.0" in out
+    assert "DEAD" not in out
+
+
+def test_monitor_record_then_replay(memory_storage, fresh_registry,
+                                    tmp_path):
+    rec_file = tmp_path / "fleet.jsonl"
+    api, server, port, _rec = _ticked_daemon(memory_storage)
+    live = io.StringIO()
+    try:
+        rc = monitor.run_monitor([f"http://localhost:{port}"],
+                                 once=True, record=str(rec_file),
+                                 out=live)
+    finally:
+        server.shutdown()
+    assert rc == 0
+    frames = [json.loads(line)
+              for line in rec_file.read_text().splitlines() if line]
+    assert len(frames) == 1 and frames[0]["targets"]
+    # replay re-renders the identical row with the fleet long gone
+    replayed = io.StringIO()
+    rc = monitor.run_monitor([], replay=str(rec_file), out=replayed)
+    assert rc == 0
+    live_row = live.getvalue().splitlines()[2]
+    replay_row = replayed.getvalue().splitlines()[2]
+    assert live_row == replay_row
+    # an empty recording is exit 2, like an all-dead fleet
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert monitor.run_monitor([], replay=str(empty),
+                               out=io.StringIO()) == 2
+
+
+def test_monitor_all_unreachable_exits_2():
+    buf = io.StringIO()
+    rc = monitor.run_monitor(["http://localhost:9"], once=True,
+                             timeout=0.5, out=buf)
+    assert rc == 2
+    assert "DEAD" in buf.getvalue()
+
+
+def test_cli_wires_monitor_and_incident():
+    parser = build_parser()
+    args = parser.parse_args(["monitor", "--targets", "http://a", "--once"])
+    assert args.command == "monitor" and args.once
+    args = parser.parse_args(["incident", "--targets", "http://a",
+                              "--window", "5m", "--trace", "cafe"])
+    assert args.command == "incident" and args.window == "5m"
+    assert args.trace == "cafe"
+
+
+# ---------------------------------------------------------------------------
+# pio incident: change-point math + the e2e timeline
+# ---------------------------------------------------------------------------
+
+def test_parse_window():
+    assert incident.parse_window("10m") == 600.0
+    assert incident.parse_window("90s") == 90.0
+    assert incident.parse_window("1h") == 3600.0
+    assert incident.parse_window("600") == 600.0
+    with pytest.raises(ValueError):
+        incident.parse_window("tenminutes")
+
+
+def test_change_points_flags_steps_not_jitter():
+    flat = [(i * 1000, 10.0) for i in range(12)]
+    assert incident.change_points(flat) == []
+    # near-zero MAD + the relative floor: 10% wiggle stays quiet
+    wiggle = [(i * 1000, 10.0 + (0.5 if i % 2 else -0.5))
+              for i in range(12)]
+    assert incident.change_points(wiggle) == []
+    # a held step reports ONCE, at the edge
+    step = [(i * 1000, 10.0 if i < 8 else 80.0) for i in range(12)]
+    cps = incident.change_points(step)
+    assert len(cps) == 1
+    assert cps[0]["t"] == 8000 and cps[0]["direction"] == "up"
+
+
+def test_incident_e2e_two_daemons(memory_storage, fresh_registry):
+    """The acceptance e2e: a fault injected into a live two-daemon
+    fleet — breaker RED in the journal (with a live trace), a p99 step
+    in the rings — assembles over HTTP into one ordered timeline."""
+    telemetry.set_enabled(True)
+    tracing.set_enabled(True)
+    journal.set_enabled(True)
+    history.set_enabled(True)
+    api1 = EventAPI(storage=memory_storage)
+    api2 = EventAPI(storage=memory_storage)
+    s1, p1 = serve_background(api1)
+    s2, p2 = serve_background(api2)
+    history.reset()
+    rec = history.install(history.HistoryConfig(), start=False)
+    h = telemetry.registry().histogram(
+        "pio_serve_seconds", "serve", labelnames=("mode",)
+    ).labels(mode="batched")
+
+    # the fault: a RED journal event emitted under a live trace
+    ctx = tracing.new_context()
+    with tracing.activate(ctx):
+        tracing.record_span("query.predict", tracing.current(), 0.048,
+                            service="engine")
+        journal.emit("breaker", "storage breaker OPEN", level="red")
+
+    # the signal: 7 healthy ticks then 2 ticks of 100x latency
+    now = _now_ms()
+    t0 = now - 60_000
+    rec.tick(wall_ms=t0)
+    for i in range(9):
+        lat = 0.002 if i < 7 else 0.2
+        for _ in range(20):
+            h.observe(lat)
+        rec.tick(wall_ms=t0 + (i + 1) * 5000)
+
+    targets = [f"http://localhost:{p1}", f"http://localhost:{p2}"]
+    try:
+        result = incident.assemble(targets, window_s=600.0)
+        buf = io.StringIO()
+        rc = incident.run_incident(targets, window="10m", out=buf)
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+
+    assert not result["errors"]
+    kinds = [e["kind"] for e in result["entries"]]
+    assert "RED" in kinds and "STEP" in kinds and "SPAN" in kinds
+    # the trace was discovered FROM the journal event, not handed in
+    assert ctx.trace_id in result["trace_ids"]
+    red = next(e for e in result["entries"] if e["kind"] == "RED")
+    assert "breaker: storage breaker OPEN" in red["detail"]
+    step = next(e for e in result["entries"] if e["kind"] == "STEP")
+    assert "p99 rose" in step["detail"]
+    span = next(e for e in result["entries"] if e["kind"] == "SPAN")
+    assert "query.predict" in span["detail"]
+    # one timeline, oldest first
+    ts = [e["ts_ms"] for e in result["entries"]]
+    assert ts == sorted(ts)
+
+    assert rc == 1                           # incident evidence found
+    out = buf.getvalue()
+    assert "VERDICT" in out and "RED event(s)" in out
+    assert "STEP" in out and "SPAN" in out
+
+
+def test_incident_all_unreachable_exits_2():
+    buf = io.StringIO()
+    rc = incident.run_incident(["http://localhost:9"], window="1m",
+                               timeout=0.5, out=buf)
+    assert rc == 2
+    assert "unreachable" in buf.getvalue()

@@ -314,6 +314,43 @@ def test_declarations_pass_fires_on_undeclared_tenant_metric():
         "metric-undeclared", "env-undeclared", "journal-undeclared")]
 
 
+def test_declarations_pass_covers_history_knobs_and_metrics():
+    """The metrics flight recorder is inside the declarations triangle:
+    an undeclared PIO_HISTORY_* knob and a ghost pio_history_* family
+    each fire exactly one finding, while the real knobs and the
+    sampler's registered families pass clean."""
+    bad_env = ("import os\n"
+               "x = os.environ.get('PIO_HISTORY_BOGUS_KNOB', '')\n")
+    found = [f for f in declarations.run([_mod(bad_env)], readme_text="")
+             if f.path != declarations._DECL_REL]
+    assert _rules(found) == ["env-undeclared"]
+    assert "PIO_HISTORY_BOGUS_KNOB" in found[0].message
+
+    bad_metric = (
+        "from predictionio_tpu.common import telemetry\n"
+        "c = telemetry.registry().counter(\n"
+        "    'pio_history_bogus_total', 'x')\n")
+    found = [f for f in declarations.run(
+        [_mod(bad_metric, rel="predictionio_tpu/common/history.py")],
+        readme_text="") if f.rule == "metric-undeclared"]
+    assert len(found) == 1
+    assert "pio_history_bogus_total" in found[0].message
+
+    ok = ("import os\n"
+          "from predictionio_tpu.common import telemetry\n"
+          "t = os.environ.get('PIO_HISTORY_TICK_S', '5')\n"
+          "m = os.environ.get('PIO_HISTORY_MAX_SERIES', '512')\n"
+          "e = os.environ.get('PIO_HISTORY', '1')\n"
+          "c = telemetry.registry().counter(\n"
+          "    'pio_history_ticks_total', 'x')\n"
+          "g = telemetry.registry().gauge('pio_history_series', 'x')\n")
+    found = declarations.run(
+        [_mod(ok, rel="predictionio_tpu/common/history.py")],
+        readme_text="")
+    assert not [f for f in found if f.rule in (
+        "metric-undeclared", "env-undeclared")]
+
+
 def test_declarations_pass_covers_partition_and_cache_families():
     """The partition-routing + response-cache subsystem is inside the
     declarations triangle: a ghost cache metric and an undeclared
@@ -515,6 +552,9 @@ _OLD_DAEMON_MODULES = (
     # PR 15: the fleet router is a fourth daemon with the same shared
     # debug surface contract
     "workflow/router.py",
+    # PR 20: the eval dashboard + admin server joined the contract so
+    # `pio monitor` can scrape all six daemons without a key
+    "tools/dashboard.py", "tools/admin.py",
 )
 
 

@@ -69,15 +69,19 @@ def test_debug_paths_parse_from_telemetry_source():
 def test_debug_paths_answer_on_event_and_storage_daemons(memory_storage):
     """Runtime half of the lint: every DEBUG_PATHS surface answers
     (non-404) on the cheap daemons — the event server, the storage
-    server, and the fleet router (a backendless one constructs fine;
-    its debug surface is independent of the fleet's health). The query
-    server's identical surface is covered by the waterfall e2e test
-    (it needs a trained model)."""
+    server, the fleet router (a backendless one constructs fine; its
+    debug surface is independent of the fleet's health), and the keyed
+    dashboard + admin servers (their telemetry surface answers BEFORE
+    auth — a scraper or `pio monitor` holds no key). The query server's
+    identical surface is covered by the waterfall e2e test (it needs a
+    trained model)."""
     import socket
 
     from predictionio_tpu.common import telemetry
     from predictionio_tpu.data.api import EventAPI
     from predictionio_tpu.data.storage.remote import StorageRPCAPI
+    from predictionio_tpu.tools.admin import AdminAPI
+    from predictionio_tpu.tools.dashboard import DashboardAPI
     from predictionio_tpu.workflow.router import RouterAPI, RouterConfig
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -87,6 +91,8 @@ def test_debug_paths_answer_on_event_and_storage_daemons(memory_storage):
         backends=(f"http://127.0.0.1:{dead_port}",), health_ms=50.0))
     apis = (EventAPI(storage=memory_storage),
             StorageRPCAPI(memory_storage, key="sekrit"),
+            DashboardAPI(storage=memory_storage, server_key="sekrit"),
+            AdminAPI(storage=memory_storage, server_key="sekrit"),
             router)
     try:
         for api in apis:
